@@ -1,0 +1,30 @@
+"""jepsen_etcd_tpu — a TPU-native distributed-systems correctness-testing framework.
+
+A from-scratch re-design of the capabilities of jepsen.etcd (the reference
+Clojure harness at /root/reference): concurrent workload generation, fault
+injection against an in-process etcd-semantics SUT, concurrent history
+recording, and — the TPU-native core — history *checkers* (linearizability
+search, transactional cycle detection, set analysis, watch-order
+verification) expressed as JAX kernels.
+
+Architecture (see SURVEY.md §7):
+
+- ``core``       history model: ops, invoke/complete pairing, packed tensors
+- ``runner``     deterministic virtual-time async runtime + generator interpreter
+- ``generators`` pure, seedable generator combinators (mix/reserve/stagger/...)
+- ``sut``        simulated etcd cluster: MVCC store, raft-ish replication,
+                 leases, locks, watches, membership, WAL byte model
+- ``client``     txn AST, error taxonomy, direct + text client backends
+- ``workloads``  register / set / append / wr / watch / lock / none
+- ``models``     sequential models for linearizability (VersionedRegister, Mutex)
+- ``checkers``   checker protocol + stats/perf/timeline/set-full/independent/
+                 linearizable (CPU oracle and TPU kernel) / elle / watch
+- ``ops``        the JAX/TPU kernels: WGL frontier BFS, boolean-matmul
+                 transitive closure, wavefront edit distance
+- ``parallel``   mesh/sharding helpers (pjit/shard_map over ICI)
+- ``nemesis``    fault-injection packages (kill/pause/partition/clock/member/
+                 corrupt/admin)
+- ``db``         cluster lifecycle automation against the simulated substrate
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,162 @@
+"""Histories: ordered op sequences with invoke/completion pairing.
+
+Mirrors jepsen.history semantics: a history is a vector of ops ordered by
+real (here: virtual) time; each client process is sequential, so an
+``invoke`` by process p pairs with the next completion (``ok``/``fail``/
+``info``) by p.  Crashed ops surface as ``info`` completions; processes are
+then retired and replaced with ``process + concurrency`` by the interpreter
+(thread recovery via ``(mod process concurrency)``, cf. reference
+``watch.clj:281-282``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Iterable, Iterator
+
+from .op import Op, INVOKE, COMPLETIONS
+
+
+def pair_index(ops: list[Op]) -> dict[int, int | None]:
+    """Map each op's ``index`` field -> its pair's ``index``.
+
+    invoke -> completion index (or None if never completed);
+    completion -> invoke index (or None for spontaneous completions, which
+    should not occur in our histories).
+
+    Keys are the ops' own ``index`` fields (not positions), so pairing
+    survives filtering: a sub-history keeps the parent's indices.
+    """
+    out: dict[int, int | None] = {}
+    open_by_process: dict[Any, int] = {}
+    for op in ops:
+        t = op.get("type")
+        p = op.get("process")
+        i = op["index"]
+        if t == INVOKE:
+            if p in open_by_process:
+                raise ValueError(
+                    f"process {p!r} invoked op {i} while op "
+                    f"{open_by_process[p]} is still open"
+                )
+            open_by_process[p] = i
+            out[i] = None
+        elif t in COMPLETIONS:
+            j = open_by_process.pop(p, None)
+            out[i] = j
+            if j is not None:
+                out[j] = i
+        else:
+            raise ValueError(f"op {i} has unknown type {t!r}")
+    return out
+
+
+class History:
+    """An immutable-by-convention sequence of ops with pairing helpers."""
+
+    def __init__(self, ops: Iterable[Op]):
+        self.ops: list[Op] = [o if isinstance(o, Op) else Op(o) for o in ops]
+        # Assign dense indices if absent.
+        for i, o in enumerate(self.ops):
+            if o.get("index") is None:
+                o["index"] = i
+        self._pairs: dict[int, int | None] | None = None
+        self._by_index: dict[int, Op] | None = None
+
+    # -- sequence protocol --------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    def __iter__(self) -> Iterator[Op]:
+        return iter(self.ops)
+
+    def __getitem__(self, i):
+        return self.ops[i]
+
+    # -- pairing ------------------------------------------------------------
+    @property
+    def pairs(self) -> dict[int, int | None]:
+        if self._pairs is None:
+            self._pairs = pair_index(self.ops)
+        return self._pairs
+
+    def by_index(self, i: int) -> Op:
+        if self._by_index is None:
+            self._by_index = {o["index"]: o for o in self.ops}
+        return self._by_index[i]
+
+    def completion(self, op: Op) -> Op | None:
+        """The completion for an invoke op (or None if it never completed)."""
+        j = self.pairs.get(op["index"])
+        return None if j is None else self.by_index(j)
+
+    def invocation(self, op: Op) -> Op | None:
+        j = self.pairs.get(op["index"])
+        return None if j is None else self.by_index(j)
+
+    # -- filters (jepsen.history-style) -------------------------------------
+    def filter(self, pred: Callable[[Op], bool]) -> "History":
+        return History([o for o in self.ops if pred(o)])
+
+    def client_ops(self) -> "History":
+        return self.filter(lambda o: isinstance(o.get("process"), int))
+
+    def nemesis_ops(self) -> "History":
+        return self.filter(lambda o: not isinstance(o.get("process"), int))
+
+    def oks(self) -> "History":
+        return self.filter(lambda o: o.get("type") == "ok")
+
+    def invokes(self) -> "History":
+        return self.filter(lambda o: o.get("type") == "invoke")
+
+    def remove_f(self, fs: set) -> "History":
+        return self.filter(lambda o: o.get("f") not in fs)
+
+    # -- (de)serialization ---------------------------------------------------
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(_jsonable(o)) for o in self.ops)
+
+    @classmethod
+    def from_jsonl(cls, text: str) -> "History":
+        ops = []
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                ops.append(Op(_unjsonable(json.loads(line))))
+        return cls(ops)
+
+    def __repr__(self) -> str:
+        return f"<History of {len(self.ops)} ops>"
+
+
+def _jsonable(x: Any) -> Any:
+    """JSON encoding that round-trips tuples and sets (tagged).
+
+    Op values use tuples structurally — e.g. the documented ``(key, value)``
+    shape for independent workloads — so a plain list coercion would silently
+    break tuple-equality in checkers over reloaded histories.
+    """
+    if isinstance(x, dict):
+        return {str(k): _jsonable(v) for k, v in x.items()}
+    if isinstance(x, tuple):
+        return {"__tuple__": [_jsonable(v) for v in x]}
+    if isinstance(x, list):
+        return [_jsonable(v) for v in x]
+    if isinstance(x, (set, frozenset)):
+        return {"__set__": sorted((_jsonable(v) for v in x), key=repr)}
+    if isinstance(x, (str, int, float, bool)) or x is None:
+        return x
+    return repr(x)  # lossy fallback for exotic values; documented
+
+
+def _unjsonable(x: Any) -> Any:
+    if isinstance(x, dict):
+        if set(x.keys()) == {"__tuple__"}:
+            return tuple(_unjsonable(v) for v in x["__tuple__"])
+        if set(x.keys()) == {"__set__"}:
+            return set(_unjsonable(v) for v in x["__set__"])
+        return {k: _unjsonable(v) for k, v in x.items()}
+    if isinstance(x, list):
+        return [_unjsonable(v) for v in x]
+    return x

@@ -1,0 +1,6 @@
+from .sim import SimLoop, Task, Future, Event, Queue, sleep, current_loop, Cancelled, wait_for
+
+__all__ = [
+    "SimLoop", "Task", "Future", "Event", "Queue", "sleep", "current_loop",
+    "Cancelled", "wait_for",
+]

@@ -1,0 +1,92 @@
+from jepsen_etcd_tpu.core import History, Op, invoke_op, ok, fail, info
+import pytest
+
+
+def test_op_attribute_access():
+    op = invoke_op(0, "read")
+    assert op.type == "invoke"
+    assert op.f == "read"
+    assert op.value is None
+    assert op.error is None  # nil-punning for missing keys
+    assert op.is_invoke and not op.is_ok
+
+
+def test_op_completions():
+    op = invoke_op(3, "write", 5)
+    done = ok(op, value=5)
+    assert done.is_ok and done.process == 3 and done.value == 5
+    assert op.is_invoke  # original untouched
+    f = fail(op, error="cas-failed")
+    assert f.is_fail and f.error == "cas-failed"
+    i = info(op, error="timeout")
+    assert i.is_info
+    assert i.is_client_op  # process 3 is a client
+
+
+def test_pairing():
+    h = History([
+        invoke_op(0, "read"),
+        invoke_op(1, "write", 1),
+        Op(type="ok", f="write", value=1, process=1),
+        Op(type="ok", f="read", value=1, process=0),
+    ])
+    assert h.pairs == {0: 3, 1: 2, 2: 1, 3: 0}
+    assert h.completion(h[0])["index"] == 3
+    assert h.invocation(h[2])["index"] == 1
+
+
+def test_pairing_unmatched_invoke():
+    h = History([
+        invoke_op(0, "read"),
+        Op(type="info", f="write", value=None, process=1),  # spontaneous
+    ])
+    assert h.pairs[0] is None
+    assert h.pairs[1] is None
+
+
+def test_double_invoke_raises():
+    h = History([invoke_op(0, "read"), invoke_op(0, "read")])
+    with pytest.raises(ValueError):
+        _ = h.pairs
+
+
+def test_filters_and_roundtrip():
+    h = History([
+        invoke_op("nemesis", "kill", ["n1"]),
+        invoke_op(0, "read"),
+        Op(type="ok", f="read", value=7, process=0),
+        Op(type="info", f="kill", value=["n1"], process="nemesis"),
+    ])
+    assert len(h.client_ops()) == 2
+    assert len(h.nemesis_ops()) == 2
+    assert len(h.oks()) == 1
+    h2 = History.from_jsonl(h.to_jsonl())
+    assert len(h2) == len(h)
+    assert h2[2].value == 7
+    assert h2.pairs  # pairing survives round-trip
+
+
+def test_filtered_history_pairing():
+    # Regression: pairing must survive filtering (indices, not positions).
+    h = History([
+        invoke_op("nemesis", "kill"),
+        Op(type="info", f="kill", process="nemesis"),
+        invoke_op(0, "read"),
+        Op(type="ok", f="read", value=3, process=0),
+    ])
+    sub = h.client_ops()
+    inv = sub[0]
+    assert inv["index"] == 2
+    comp = sub.completion(inv)
+    assert comp is not None and comp.value == 3
+
+
+def test_tuple_value_roundtrip():
+    # Regression: (key, value) tuples must survive JSONL round-trip.
+    h = History([
+        invoke_op(0, "txn", [("r", 5, None), ("append", 5, 1)]),
+        Op(type="ok", f="read", value=("k", 1), process=0),
+    ])
+    h2 = History.from_jsonl(h.to_jsonl())
+    assert h2[1].value == ("k", 1)
+    assert h2[0].value == [("r", 5, None), ("append", 5, 1)]

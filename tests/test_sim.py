@@ -1,0 +1,193 @@
+import pytest
+
+from jepsen_etcd_tpu.runner.sim import (
+    SimLoop, Event, Queue, Cancelled, SECOND,
+    set_current_loop, sleep, wait_for, gather,
+)
+
+
+@pytest.fixture
+def loop():
+    l = SimLoop(seed=42)
+    set_current_loop(l)
+    yield l
+    set_current_loop(None)
+
+
+def test_virtual_time_sleep(loop):
+    trace = []
+
+    async def worker(name, dt):
+        await sleep(dt)
+        trace.append((name, loop.now))
+
+    async def main():
+        a = loop.spawn(worker("a", 3 * SECOND))
+        b = loop.spawn(worker("b", 1 * SECOND))
+        await gather(a, b)
+
+    loop.run_coro(main())
+    assert trace == [("b", 1 * SECOND), ("a", 3 * SECOND)]
+    assert loop.now == 3 * SECOND
+
+
+def test_determinism():
+    def run_once():
+        l = SimLoop(seed=7)
+        set_current_loop(l)
+        trace = []
+
+        async def w(i):
+            await sleep(l.rng.randint(0, SECOND))
+            trace.append((i, l.now))
+
+        async def main():
+            await gather(*[l.spawn(w(i)) for i in range(10)])
+
+        l.run_coro(main())
+        set_current_loop(None)
+        return trace
+
+    assert run_once() == run_once()
+
+
+def test_wait_for_timeout(loop):
+    cancelled = []
+
+    async def slow():
+        try:
+            await sleep(10 * SECOND)
+        except Cancelled:
+            cancelled.append(loop.now)
+            raise
+
+    async def main():
+        t = loop.spawn(slow())
+        with pytest.raises(TimeoutError):
+            await wait_for(t, 2 * SECOND)
+
+    loop.run_coro(main())
+    assert cancelled == [2 * SECOND]
+    assert loop.now == 2 * SECOND  # virtual clock did not run to 10s
+
+
+def test_wait_for_success(loop):
+    async def quick():
+        await sleep(SECOND)
+        return "done"
+
+    async def main():
+        return await wait_for(loop.spawn(quick()), 5 * SECOND)
+
+    assert loop.run_coro(main()) == "done"
+
+
+def test_event(loop):
+    order = []
+
+    async def waiter(i):
+        ev_wait = ev.wait()
+        await ev_wait
+        order.append(i)
+
+    async def setter():
+        await sleep(SECOND)
+        ev.set()
+
+    async def main():
+        ts = [loop.spawn(waiter(i)) for i in range(3)]
+        loop.spawn(setter())
+        await gather(*ts)
+
+    ev = None
+
+    async def top():
+        nonlocal ev
+        ev = Event(loop)
+        await main()
+
+    loop.run_coro(top())
+    assert order == [0, 1, 2]
+
+
+def test_queue(loop):
+    got = []
+
+    async def consumer(q):
+        for _ in range(3):
+            got.append(await q.get())
+
+    async def main():
+        q = Queue(loop)
+        c = loop.spawn(consumer(q))
+        q.put(1)
+        await sleep(SECOND)
+        q.put(2)
+        q.put(3)
+        await c
+
+    loop.run_coro(main())
+    assert got == [1, 2, 3]
+
+
+def test_exception_propagates(loop):
+    async def boom():
+        await sleep(1)
+        raise ValueError("boom")
+
+    async def main():
+        await loop.spawn(boom())
+
+    with pytest.raises(ValueError):
+        loop.run_coro(main())
+
+
+def test_max_time_resumable(loop):
+    # Regression: run(max_time=) must not drop the event it stops before.
+    ticks = []
+
+    async def ticker():
+        for _ in range(4):
+            await sleep(2 * SECOND)
+            ticks.append(loop.now)
+
+    t = loop.spawn(ticker())
+    loop.run(max_time=3 * SECOND)
+    assert ticks == [2 * SECOND]
+    loop.run(until=t)  # resume: the 4s wakeup must still fire
+    assert ticks == [2 * SECOND, 4 * SECOND, 6 * SECOND, 8 * SECOND]
+
+
+def test_wait_for_success_leaves_clock_clean(loop):
+    # Regression: stale timeout timers must not inflate the clock on drain.
+    async def quick():
+        await sleep(SECOND)
+        return 1
+
+    async def main():
+        return await wait_for(loop.spawn(quick()), 3600 * SECOND)
+
+    loop.run_coro(main())
+    loop.run()  # full drain
+    assert loop.now == SECOND
+
+
+def test_queue_get_cancelled_does_not_lose_items(loop):
+    # Regression: an item delivered to a cancelled getter must be re-queued.
+    got = []
+
+    async def getter(q):
+        return await q.get()
+
+    async def main():
+        q = Queue(loop)
+        t1 = loop.spawn(getter(q))
+        await sleep(1)
+        t1.cancel()
+        q.put("x")  # may race with the cancellation delivery
+        await sleep(1)
+        t2 = loop.spawn(getter(q))
+        got.append(await t2)
+
+    loop.run_coro(main())
+    assert got == ["x"]
