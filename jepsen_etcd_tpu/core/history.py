@@ -56,10 +56,17 @@ class History:
 
     def __init__(self, ops: Iterable[Op]):
         self.ops: list[Op] = [o if isinstance(o, Op) else Op(o) for o in ops]
-        # Assign dense indices if absent.
+        # Assign indices to ops missing one, starting past any explicit
+        # indices (so synthesized ops appended to a recorded history can't
+        # collide); copy rather than mutate the caller's op.
+        explicit = [o["index"] for o in self.ops if o.get("index") is not None]
+        if len(explicit) != len(set(explicit)):
+            raise ValueError("duplicate op indices in history")
+        nxt = max(explicit, default=-1) + 1
         for i, o in enumerate(self.ops):
             if o.get("index") is None:
-                o["index"] = i
+                self.ops[i] = o.evolve(index=nxt)
+                nxt += 1
         self._pairs: dict[int, int | None] | None = None
         self._by_index: dict[int, Op] | None = None
 
@@ -138,7 +145,12 @@ def _jsonable(x: Any) -> Any:
     break tuple-equality in checkers over reloaded histories.
     """
     if isinstance(x, dict):
-        return {str(k): _jsonable(v) for k, v in x.items()}
+        if all(isinstance(k, str) for k in x) and not (
+                set(x.keys()) & {"__tuple__", "__set__", "__dict__"}):
+            return {k: _jsonable(v) for k, v in x.items()}
+        # Non-string (or tag-colliding) keys: tagged pair-list encoding.
+        return {"__dict__": [[_jsonable(k), _jsonable(v)]
+                             for k, v in x.items()]}
     if isinstance(x, tuple):
         return {"__tuple__": [_jsonable(v) for v in x]}
     if isinstance(x, list):
@@ -150,12 +162,24 @@ def _jsonable(x: Any) -> Any:
     return repr(x)  # lossy fallback for exotic values; documented
 
 
+def _hashable(x: Any) -> Any:
+    """Make a decoded key usable as a dict key (lists -> tuples)."""
+    if isinstance(x, list):
+        return tuple(_hashable(v) for v in x)
+    if isinstance(x, set):
+        return frozenset(x)
+    return x
+
+
 def _unjsonable(x: Any) -> Any:
     if isinstance(x, dict):
         if set(x.keys()) == {"__tuple__"}:
             return tuple(_unjsonable(v) for v in x["__tuple__"])
         if set(x.keys()) == {"__set__"}:
             return set(_unjsonable(v) for v in x["__set__"])
+        if set(x.keys()) == {"__dict__"}:
+            return {_hashable(_unjsonable(k)): _unjsonable(v)
+                    for k, v in x["__dict__"]}
         return {k: _unjsonable(v) for k, v in x.items()}
     if isinstance(x, list):
         return [_unjsonable(v) for v in x]

@@ -1,0 +1,188 @@
+"""Key-level data parallelism: jepsen.independent re-designed.
+
+``concurrent_generator(n, keys, gen_fn)`` partitions client threads into
+groups of ``n``; each group works through keys from a shared (possibly
+infinite) key sequence, running ``gen_fn(key)`` with op values wrapped as
+``(key, v)`` tuples.  This is the main data-parallel axis of the framework:
+the matching ``independent`` *checker* (checkers/independent.py) splits the
+history back per key — and on TPU, vmaps the per-key linearizability search
+over the key batch.
+
+Reference: register workload composition at ``register.clj:113-119``
+(``independent/concurrent-generator (* 2 n) (range) (fn [k] ...)``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Iterable, Optional
+
+from ..core.op import Op
+from .core import (
+    Generator, Context, PENDING, ensure_gen, _min_wake,
+)
+
+EXHAUSTED = object()
+
+
+class KeySeq:
+    """Append-only memo over a (possibly infinite) key iterable: shared,
+    deterministic, safe for the committed-poll protocol."""
+
+    def __init__(self, keys: Iterable):
+        self._memo: list = []
+        self._it = iter(keys)
+
+    def get(self, i: int):
+        while i >= len(self._memo) and self._it is not None:
+            try:
+                self._memo.append(next(self._it))
+            except StopIteration:
+                self._it = None
+        return self._memo[i] if i < len(self._memo) else EXHAUSTED
+
+
+def tuple_value(k: Any, v: Any) -> tuple:
+    return (k, v)
+
+
+def untuple(op: Op) -> Op:
+    """Strip the (key, v) wrapper from an op's value."""
+    v = op.get("value")
+    if isinstance(v, tuple) and len(v) == 2:
+        return op.evolve(value=v[1])
+    return op
+
+
+@dataclass(frozen=True)
+class ConcurrentGenerator(Generator):
+    """Thread groups of size n, each processing keys independently."""
+
+    n: int
+    keys: KeySeq
+    gen_fn: Callable
+    # (threads_frozenset, key_or_None, gen_or_None) per group, once resolved
+    groups: Optional[tuple] = None
+    next_key: int = 0
+
+    def _resolve(self, ctx: Context) -> "ConcurrentGenerator":
+        if self.groups is not None:
+            return self
+        threads = sorted(t for t in ctx.workers if isinstance(t, int))
+        gs = []
+        for at in range(0, len(threads) - self.n + 1, self.n):
+            gs.append((frozenset(threads[at:at + self.n]), None, None))
+        if not gs:
+            raise ValueError(
+                f"concurrent_generator: {len(threads)} client threads is "
+                f"fewer than group size {self.n}")
+        return replace(self, groups=tuple(gs))
+
+    def _fresh(self, me: "ConcurrentGenerator", i: int):
+        """Give group i a new key's generator; returns (me', group) or
+        (me', None) when the key sequence is exhausted."""
+        k = me.keys.get(me.next_key)
+        if k is EXHAUSTED:
+            return me, None
+        threads = me.groups[i][0]
+        child = ensure_gen(me.gen_fn(k))
+        group = (threads, k, child)
+        gs = list(me.groups)
+        gs[i] = group
+        return replace(me, groups=tuple(gs), next_key=me.next_key + 1), group
+
+    def op(self, test, ctx):
+        me = self._resolve(ctx)
+        best = None  # (op, i, key, gen2)
+        pend_wake = "none"
+        pending_any = False
+        for i in range(len(me.groups)):
+            threads, key, g = me.groups[i]
+            if g is None:
+                me, group = self._fresh(me, i)
+                if group is None:
+                    continue  # keys exhausted; group retires
+                threads, key, g = group
+            sub = ctx.restrict(threads)
+            # A group may need several polls if its gen exhausts: move to
+            # the next key immediately.
+            while True:
+                res = g.op(test, sub)
+                if res is None:
+                    me, group = self._fresh(me, i)
+                    if group is None:
+                        g = None
+                        break
+                    threads, key, g = group
+                    continue
+                break
+            if g is None:
+                gs = list(me.groups)
+                gs[i] = (me.groups[i][0], None, None)
+                me = replace(me, groups=tuple(gs))
+                continue
+            if res[0] == PENDING:
+                _, wake, g2 = res
+                pend_wake = _min_wake(pend_wake, wake)
+                pending_any = True
+                gs = list(me.groups)
+                gs[i] = (threads, key, g2)
+                me = replace(me, groups=tuple(gs))
+                continue
+            op, g2 = res
+            if best is None or op["time"] < best[0]["time"]:
+                best = (op, i, key, g2, threads)
+        if best is not None:
+            op, i, key, g2, threads = best
+            gs = list(me.groups)
+            gs[i] = (threads, key, g2)
+            me = replace(me, groups=tuple(gs))
+            wrapped = op.evolve(value=(key, op.get("value")))
+            return (wrapped, me)
+        alive = any(g is not None for _, _, g in me.groups) \
+            or me.keys.get(me.next_key) is not EXHAUSTED
+        if not alive:
+            return None
+        if not pending_any and not alive:
+            return None
+        return (PENDING, None if pend_wake == "none" else pend_wake, me)
+
+    def update(self, test, ctx, event):
+        if self.groups is None:
+            return self
+        p = event.get("process")
+        if not isinstance(p, int):
+            return self
+        t = ctx.thread_of(p)
+        gs = list(self.groups)
+        for i, (threads, key, g) in enumerate(gs):
+            if g is not None and t in threads:
+                gs[i] = (threads, key,
+                         g.update(test, ctx.restrict(threads),
+                                  untuple(event)))
+                return replace(self, groups=tuple(gs))
+        return self
+
+
+def concurrent_generator(n: int, keys: Iterable, gen_fn: Callable) -> Generator:
+    return ConcurrentGenerator(n, KeySeq(keys), gen_fn)
+
+
+def history_keys(history) -> list:
+    """All keys appearing in (key, v) tuple values, in first-seen order."""
+    seen: dict = {}
+    for op in history:
+        v = op.get("value")
+        if isinstance(v, tuple) and len(v) == 2:
+            seen.setdefault(v[0], None)
+    return list(seen)
+
+
+def subhistory(history, key) -> list:
+    """Ops for one key, values unwrapped; preserves op indices."""
+    out = []
+    for op in history:
+        v = op.get("value")
+        if isinstance(v, tuple) and len(v) == 2 and v[0] == key:
+            out.append(op.evolve(value=v[1]))
+    return out

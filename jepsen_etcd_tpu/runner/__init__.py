@@ -1,6 +1,9 @@
-from .sim import SimLoop, Task, Future, Event, Queue, sleep, current_loop, Cancelled, wait_for
+from .sim import (
+    SimLoop, Task, Future, Event, Queue, sleep, current_loop, Cancelled,
+    wait_for, gather,
+)
 
 __all__ = [
     "SimLoop", "Task", "Future", "Event", "Queue", "sleep", "current_loop",
-    "Cancelled", "wait_for",
+    "Cancelled", "wait_for", "gather",
 ]

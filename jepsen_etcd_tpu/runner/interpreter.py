@@ -1,0 +1,151 @@
+"""The generator interpreter: schedules generator ops onto worker tasks.
+
+The analog of jepsen.generator.interpreter (SURVEY §3.1 "HOT LOOP #1"),
+re-designed for the virtual-time runtime.
+
+Design (mirrors jepsen's): a coordinator coroutine polls the generator
+(committed-poll protocol, see generators/core.py) and *immediately*
+dispatches each op to its thread's worker inbox, marking the thread busy —
+even ops whose :time is in the future (the worker sleeps until then). This
+keeps a far-future op (e.g. a staggered nemesis op) from blocking other
+threads' dispatch. Workers send invoke/completion events back on a single
+queue; the coordinator records them in arrival (= virtual-time) order and
+feeds them to generator.update.
+
+Process semantics mirror jepsen: thread t starts as process t; when an op
+completes as :info (indefinite — the worker may still hold resources), the
+process is retired and replaced by process + concurrency, so thread =
+process mod concurrency (cf. reference watch.clj:281-282).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..core.op import Op, NEMESIS, INFO
+from ..core.history import History
+from ..generators.core import Context, ensure_gen, PENDING
+from .sim import SimLoop, Queue, current_loop, sleep, wait_for
+
+import logging
+
+logger = logging.getLogger("jepsen_etcd_tpu.run")
+
+
+async def interpret(
+    test: Any,
+    gen: Any,
+    invoke: Callable,  # async (process, op) -> completed Op
+    concurrency: int,
+    nemesis_invoke: Optional[Callable] = None,  # async (op) -> completed Op
+    loop: Optional[SimLoop] = None,
+    on_op: Optional[Callable] = None,  # observer: called with each recorded op
+) -> History:
+    """Run a generator to exhaustion; returns the recorded history."""
+    loop = loop or current_loop()
+    gen = ensure_gen(gen)
+
+    threads: list = list(range(concurrency)) + (
+        [NEMESIS] if nemesis_invoke is not None else [])
+    workers = {t: t for t in threads}
+    free = set(threads)
+    inboxes = {t: Queue(loop) for t in threads}
+    events: Queue = Queue(loop)  # ("invoke"|"complete", thread, op)
+    history: list[Op] = []
+    index = [0]
+
+    def record(op: Op) -> Op:
+        op = op.evolve(index=index[0], time=loop.now)
+        index[0] += 1
+        history.append(op)
+        if on_op is not None:
+            on_op(op)
+        return op
+
+    def ctx() -> Context:
+        return Context(time=loop.now, free=frozenset(free),
+                       workers=dict(workers), rng=loop.rng,
+                       concurrency=concurrency)
+
+    async def worker(thread: Any) -> None:
+        while True:
+            op = await inboxes[thread].get()
+            if op is None:
+                return
+            if op["time"] > loop.now:
+                await sleep(op["time"] - loop.now)
+            op = op.evolve(process=workers[thread])
+            events.put(("invoke", thread, op))
+            try:
+                if thread == NEMESIS:
+                    done = await nemesis_invoke(op)
+                else:
+                    done = await invoke(workers[thread], op)
+            except Exception as e:  # a worker crash is an indefinite op
+                logger.exception("worker %r crashed on %r", thread, op)
+                done = op.evolve(type=INFO, error=("worker-crash", repr(e)))
+            events.put(("complete", thread, Op(done)))
+
+    tasks = [loop.spawn(worker(t), name=f"worker-{t}") for t in threads]
+
+    def handle(kind: str, thread: Any, op: Op) -> None:
+        nonlocal gen
+        op = record(op)
+        if kind == "complete":
+            free.add(thread)
+            if op.get("type") == INFO and isinstance(thread, int):
+                workers[thread] = workers[thread] + concurrency
+        if gen is not None:
+            gen = gen.update(test, ctx(), op)
+
+    async def next_event(deadline: Optional[int] = None) -> None:
+        """Handle one event; give up at deadline (virtual time) if given."""
+        if deadline is None:
+            kind, thread, op = await events.get()
+        else:
+            if loop.now >= deadline:
+                return
+            try:
+                kind, thread, op = await wait_for(
+                    loop.spawn(events.get(), name="evget"),
+                    deadline - loop.now)
+            except TimeoutError:
+                return
+        handle(kind, thread, op)
+
+    while True:
+        # Drain any already-queued events first so ctx is fresh.
+        while len(events):
+            kind, thread, op = await events.get()
+            handle(kind, thread, op)
+        res = gen.op(test, ctx()) if gen is not None else None
+        if res is None:
+            if len(free) == len(threads):
+                break
+            await next_event()
+            continue
+        if res[0] == PENDING:
+            _, wake, gen = res
+            if wake is not None and wake > loop.now:
+                await next_event(deadline=wake)
+            else:
+                await next_event()
+            continue
+        op, gen = res
+        if op.get("type") == "log":
+            logger.info("%s", op.get("value"))
+            continue
+        thread = op["process"] if not isinstance(op["process"], int) \
+            else op["process"] % concurrency
+        if thread not in free:
+            # Soonest-op races can hand us a busy thread; wait for change.
+            await next_event()
+            continue
+        free.discard(thread)
+        inboxes[thread].put(op)
+
+    for t in threads:
+        inboxes[t].put(None)  # retire workers
+    for t in tasks:
+        await t
+    return History(history)

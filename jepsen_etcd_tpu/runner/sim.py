@@ -332,12 +332,17 @@ class Queue:
         try:
             return await f
         except BaseException:
-            # Cancelled while waiting: withdraw, or re-queue an item that
-            # was delivered to us but never consumed.
+            # Cancelled while waiting: withdraw, or re-route an item that
+            # was delivered to us but never consumed — to the next waiting
+            # getter if any (they won't be woken by a future put), else
+            # back to the head of the queue.
             if f in self._getters:
                 self._getters.remove(f)
             elif f._state == Future.DONE:
-                self._items.appendleft(f._result)
+                if self._getters:
+                    self._getters.popleft().set_result(f._result)
+                else:
+                    self._items.appendleft(f._result)
             raise
 
     def __len__(self) -> int:
@@ -345,12 +350,18 @@ class Queue:
 
 
 async def gather(*aws: Future) -> list:
-    """Await all; raises the first exception encountered (after all settle)."""
+    """Await all; raises the first child exception after all settle.
+
+    A Cancelled thrown into the *gathering* task itself propagates
+    immediately — op-timeout cancellation must terminate the caller.
+    """
     results = []
     first_exc: BaseException | None = None
     for a in aws:
         try:
             results.append(await a)
+        except Cancelled:
+            raise
         except BaseException as e:  # noqa: BLE001 - propagate after settling
             if first_exc is None:
                 first_exc = e

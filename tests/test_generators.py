@@ -1,0 +1,175 @@
+import pytest
+
+from jepsen_etcd_tpu.core.op import Op, NEMESIS
+from jepsen_etcd_tpu.generators import (
+    mix, limit, stagger, time_limit, phases, reserve, nemesis, clients,
+    each_thread, sleep_gen, log, independent, repeat,
+)
+from jepsen_etcd_tpu.runner.sim import SimLoop, set_current_loop, sleep, SECOND
+from jepsen_etcd_tpu.runner.interpreter import interpret
+
+
+def run_gen(gen, concurrency=4, seed=0, latency=int(0.05 * SECOND),
+            invoke=None, nemesis_invoke=None, test=None):
+    loop = SimLoop(seed=seed)
+    set_current_loop(loop)
+
+    async def default_invoke(process, op):
+        await sleep(loop.rng.randint(1, latency))
+        return op.evolve(type="ok")
+
+    async def main():
+        return await interpret(test or {}, gen, invoke or default_invoke,
+                               concurrency, nemesis_invoke=nemesis_invoke)
+
+    h = loop.run_coro(main())
+    set_current_loop(None)
+    return h
+
+
+def r(test, ctx):
+    return {"f": "read", "value": None}
+
+
+def w(test, ctx):
+    return {"f": "write", "value": ctx.rng.randint(0, 4)}
+
+
+def test_limit_and_mix():
+    h = run_gen(limit(20, mix([r, w])))
+    invokes = h.invokes()
+    assert len(invokes) == 20
+    fs = {op.f for op in invokes}
+    assert fs == {"read", "write"}
+    # every op completes
+    assert all(h.completion(op) is not None for op in invokes)
+
+
+def test_reserve_partitions_threads():
+    # 2 threads read-only, remaining 2 write-only (set.clj:47 shape)
+    gen = limit(40, reserve(2, repeat({"f": "read"}), repeat({"f": "write"})))
+    h = run_gen(gen, concurrency=4)
+    for op in h.invokes():
+        thread = op.process % 4
+        if op.f == "read":
+            assert thread in (0, 1)
+        else:
+            assert thread in (2, 3)
+
+
+def test_stagger_rate():
+    # 50 ops at mean 0.1s spacing ~ 5s total
+    gen = limit(50, stagger(int(0.1 * SECOND), r))
+    h = run_gen(gen, concurrency=4)
+    times = [op.time for op in h.invokes()]
+    total = (times[-1] - times[0]) / SECOND
+    assert 2.0 < total < 10.0  # mean gap 0.1s -> ~4.9s expected
+
+
+def test_time_limit_cuts_off():
+    gen = time_limit(1 * SECOND, stagger(int(0.01 * SECOND), r))
+    h = run_gen(gen, concurrency=4)
+    assert len(h) > 10
+    assert all(op.time <= 1 * SECOND for op in h.invokes())
+
+
+def test_phases_barrier():
+    gen = phases(
+        limit(8, repeat({"f": "a"})),
+        limit(8, repeat({"f": "b"})),
+    )
+    h = run_gen(gen, concurrency=4)
+    assert len([op for op in h.invokes() if op.f == "a"]) == 8
+    assert len([op for op in h.invokes() if op.f == "b"]) == 8
+    a_completes = [op.time for op in h if op.is_completion and op.f == "a"]
+    b_invokes = [op.time for op in h if op.is_invoke and op.f == "b"]
+    assert a_completes and b_invokes
+    assert min(b_invokes) >= max(a_completes)
+
+
+def test_each_thread():
+    h = run_gen(each_thread({"f": "final"}), concurrency=4)
+    invs = h.invokes()
+    assert len(invs) == 4
+    assert {op.process % 4 for op in invs} == {0, 1, 2, 3}
+
+
+def test_nemesis_routing():
+    async def nem_invoke(op):
+        await sleep(int(0.02 * SECOND))
+        return op.evolve(type="info")
+
+    gen = time_limit(
+        2 * SECOND,
+        nemesis(
+            repeat({"f": "kill"}),
+            stagger(int(0.05 * SECOND), r),
+        ),
+    )
+    h = run_gen(gen, concurrency=2, nemesis_invoke=nem_invoke)
+    kills = [op for op in h if op.f == "kill"]
+    reads = [op for op in h if op.f == "read"]
+    assert kills and reads
+    assert all(op.process == NEMESIS for op in kills)
+    assert all(isinstance(op.process, int) for op in reads)
+
+
+def test_info_bumps_process():
+    count = [0]
+
+    async def flaky(process, op):
+        await sleep(int(0.01 * SECOND))
+        count[0] += 1
+        if count[0] == 3:
+            return op.evolve(type="info", error="timeout")
+        return op.evolve(type="ok")
+
+    h = run_gen(limit(10, r), concurrency=2, invoke=flaky)
+    procs = {op.process for op in h.invokes()}
+    assert any(p >= 2 for p in procs)  # some process got bumped
+    # pairing still works: thread = process % concurrency is sequential
+    assert all(h.completion(op) is not None for op in h.invokes())
+
+
+def test_concurrent_generator_keys():
+    gen = independent.concurrent_generator(
+        2, range(100),
+        lambda k: limit(6, mix([r, w])),
+    )
+    h = run_gen(time_limit(20 * SECOND, gen), concurrency=4)
+    invs = h.invokes()
+    assert invs
+    keys = {op.value[0] for op in invs}
+    assert len(keys) >= 2  # 2 groups of 2 threads each, working in parallel
+    # values are (k, v) tuples
+    for op in invs:
+        assert isinstance(op.value, tuple) and len(op.value) == 2
+    # each key sees at most 6 invokes
+    from collections import Counter
+    per_key = Counter(op.value[0] for op in invs)
+    assert all(c <= 6 for c in per_key.values())
+    # subhistory unwraps
+    k0 = sorted(keys)[0]
+    sub = independent.subhistory(h, k0)
+    assert sub and not isinstance(sub[0].value, tuple)
+
+
+def test_sleep_gen_and_log():
+    gen = phases(
+        sleep_gen(1 * SECOND),
+        log("hello"),
+        limit(2, r),
+    )
+    h = run_gen(gen, concurrency=2)
+    invs = h.invokes()
+    assert len(invs) == 2
+    assert all(op.time >= 1 * SECOND for op in invs)
+    assert all(op.f != "log" for op in h)  # log ops not recorded
+
+
+def test_determinism_full_stack():
+    def once_run():
+        gen = time_limit(3 * SECOND, stagger(int(0.02 * SECOND), mix([r, w])))
+        return run_gen(gen, concurrency=4, seed=123).to_jsonl()
+
+    assert once_run() == once_run()

@@ -90,3 +90,22 @@ def test_tuple_value_roundtrip():
     h2 = History.from_jsonl(h.to_jsonl())
     assert h2[1].value == ("k", 1)
     assert h2[0].value == [("r", 5, None), ("append", 5, 1)]
+
+
+def test_dict_key_and_index_collision_fixes():
+    # Regression: non-string dict keys survive round-trip.
+    h = History([Op(type="ok", f="read", process=0,
+                    value={5: "a", ("k", 1): 2})])
+    h2 = History.from_jsonl(h.to_jsonl())
+    assert h2[0].value == {5: "a", ("k", 1): 2}
+
+    # Regression: appending unindexed ops to indexed history can't collide.
+    h3 = History([Op(type="invoke", f="r", process=0, index=1),
+                  Op(type="ok", f="r", process=0)])
+    assert h3[1]["index"] == 2
+    assert h3.pairs == {1: 2, 2: 1}
+
+    # Duplicate explicit indices are an error, not silent corruption.
+    with pytest.raises(ValueError):
+        History([Op(type="invoke", f="r", process=0, index=1),
+                 Op(type="ok", f="r", process=0, index=1)])

@@ -191,3 +191,43 @@ def test_queue_get_cancelled_does_not_lose_items(loop):
 
     loop.run_coro(main())
     assert got == ["x"]
+
+
+def test_gather_cancel_propagates(loop):
+    # Regression: cancelling a task blocked in gather() must terminate it.
+    async def hang():
+        await loop.future()
+
+    async def gatherer():
+        await gather(loop.spawn(hang()), loop.spawn(hang()))
+
+    async def main():
+        t = loop.spawn(gatherer())
+        await sleep(1)
+        t.cancel()
+        await sleep(1)
+        assert t.done
+
+    loop.run_coro(main())
+
+
+def test_queue_reroute_wakes_other_getter(loop):
+    # Regression: item delivered to a cancelled getter goes to the next
+    # waiting getter, not stranded in the buffer.
+    got = []
+
+    async def getter(q):
+        got.append(await q.get())
+
+    async def main():
+        q = Queue(loop)
+        t1 = loop.spawn(getter(q))
+        t2 = loop.spawn(getter(q))
+        await sleep(1)
+        t1.cancel()
+        q.put("x")
+        await sleep(1)
+        assert got == ["x"]
+        assert len(q) == 0
+
+    loop.run_coro(main())
