@@ -173,6 +173,10 @@ class FnGen(Generator):
         return self.f(test, ctx)
 
     def op(self, test, ctx):
+        if not ctx.free:
+            # Don't invoke f speculatively: a stateful source (e.g. popping
+            # a finite list) would lose the produced op.
+            return (PENDING, None, self)
         raw = self._call(test, ctx)
         if raw is None:
             return None
@@ -778,6 +782,10 @@ class Reserve(Generator):
         if self.resolved is not None:
             return self
         threads = sorted(t for t in ctx.workers if isinstance(t, int))
+        if sum(self.counts) > len(threads):
+            raise ValueError(
+                f"reserve: {sum(self.counts)} reserved threads > "
+                f"{len(threads)} client threads")
         branches = []
         at = 0
         for n, g in zip(self.counts, self.gens):
@@ -785,8 +793,9 @@ class Reserve(Generator):
                                       ensure_gen(g)))
             at += n
         default = self.gens[len(self.counts)]
-        branches.append(OnThreads(frozenset(threads[at:]),
-                                  ensure_gen(default)))
+        if threads[at:]:  # an empty branch would pend forever
+            branches.append(OnThreads(frozenset(threads[at:]),
+                                      ensure_gen(default)))
         return replace(self, resolved=Alt(tuple(branches)))
 
     def op(self, test, ctx):

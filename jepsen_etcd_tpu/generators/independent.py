@@ -143,8 +143,6 @@ class ConcurrentGenerator(Generator):
             or me.keys.get(me.next_key) is not EXHAUSTED
         if not alive:
             return None
-        if not pending_any and not alive:
-            return None
         return (PENDING, None if pend_wake == "none" else pend_wake, me)
 
     def update(self, test, ctx, event):

@@ -92,7 +92,8 @@ async def interpret(
         nonlocal gen
         op = record(op)
         if kind == "complete":
-            free.add(thread)
+            if len(inboxes[thread]) == 0:
+                free.add(thread)
             if op.get("type") == INFO and isinstance(thread, int):
                 workers[thread] = workers[thread] + concurrency
         if gen is not None:
@@ -137,10 +138,10 @@ async def interpret(
             continue
         thread = op["process"] if not isinstance(op["process"], int) \
             else op["process"] % concurrency
-        if thread not in free:
-            # Soonest-op races can hand us a busy thread; wait for change.
-            await next_event()
-            continue
+        # The generator state for this op is already committed, so the op
+        # must not be dropped: enqueue even onto a busy thread (the worker
+        # drains its inbox sequentially); `free` stays false until the
+        # inbox is empty again (see handle()).
         free.discard(thread)
         inboxes[thread].put(op)
 

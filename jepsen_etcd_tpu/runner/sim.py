@@ -361,7 +361,11 @@ async def gather(*aws: Future) -> list:
         try:
             results.append(await a)
         except Cancelled:
-            raise
+            if not a.done:
+                raise  # thrown into *us*, not raised by a settled child
+            if first_exc is None:
+                first_exc = a._result  # child's own cancellation
+            results.append(None)
         except BaseException as e:  # noqa: BLE001 - propagate after settling
             if first_exc is None:
                 first_exc = e

@@ -1,0 +1,999 @@
+"""The simulated etcd cluster: replicated MVCC over a raft-style log.
+
+This is the system-under-test substrate replacing the reference's real
+5-node etcd cluster (``db.clj`` installs/starts real binaries; we simulate).
+Faithfulness targets (SURVEY §2.2 "etcd server" row):
+
+- **Consensus**: leader election with the Raft voting restriction (votes
+  only for candidates with an up-to-date log) and the leader-commits-only-
+  its-own-term rule (noop entry on election), so the cluster is
+  linearizable by default — the register workload must PASS against a
+  healthy or crash-faulted cluster, and genuinely LOSE data only in the
+  scenarios real etcd does (e.g. majority kill with lazyfs-style loss of
+  unfsynced WAL tail, cf. db.clj:264-267).
+- **Durability model**: per-node WAL + snapshot byte buffers with record
+  CRCs (wal.py). With ``unsafe_no_fsync`` (the reference passes
+  ``--unsafe-no-fsync``, db.clj:88) appends are durable only up to the
+  last snapshot/fsync; a lazyfs kill drops the unfsynced tail. Corruption
+  faults flip bits / truncate these buffers; replay panics on a damaged
+  committed record (log-file-pattern checker bait, etcd.clj:134-140).
+- **Client semantics**: linearizable ops execute at the leader (followers
+  forward); serializable reads are node-local (stale under partition);
+  leases are leader-timed and reset to full TTL on leader change (the
+  etcd behavior that makes locks unsafe, lock.clj); watches stream each
+  node's *applied* events in revision order.
+- **Faults**: kill/start (with optional lost unfsynced writes), pause/
+  resume (SIGSTOP: node unreachable, connections hang), partitions
+  (node<->node only; clients always reach nodes, like jepsen's control
+  node), clock skew (shifts lease expiry), membership add/remove,
+  WAL/snapshot corruption, compaction, defrag.
+
+Everything runs on the deterministic virtual-time loop.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..runner.sim import SimLoop, Future, Event as SimEvent, sleep, SECOND
+from .errors import SimError
+from .store import Store, Txn, Event
+from . import wal as walmod
+
+logger = logging.getLogger("jepsen_etcd_tpu.sut")
+
+MS = 1_000_000  # virtual ns
+
+
+@dataclass
+class ClusterConfig:
+    election_timeout: int = 1000 * MS     # etcd default 1s
+    heartbeat_interval: int = 100 * MS    # etcd default 100ms
+    repl_delay: tuple = (1 * MS, 5 * MS)  # node->node replication latency
+    rpc_delay: tuple = (1 * MS, 3 * MS)   # client->node latency (per leg)
+    snapshot_count: int = 100             # reference stress default
+    unsafe_no_fsync: bool = True          # reference passes this flag
+    lazyfs: bool = False                  # lose unfsynced writes on kill
+    tick: int = 50 * MS                   # scheduler granularity
+
+
+@dataclass
+class LogEntry:
+    index: int
+    term: int
+    kind: str      # "txn" | "noop" | "compact" | "member_add" |
+                   # "member_remove" | "lease_grant" | "lease_revoke"
+    payload: Any = None
+
+
+class Node:
+    def __init__(self, name: str, cluster: "Cluster", membership: list):
+        self.name = name
+        self.cluster = cluster
+        self.alive = False
+        self.paused = False
+        self.removed = False
+        self.clock_offset = 0
+        # raft volatile
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.role = "follower"
+        self.leader_hint: Optional[str] = None
+        self.election_deadline = 0
+        self.last_quorum_contact = 0
+        # log: entries [log_start..]; index 0 is a sentinel before start
+        self.log: list[LogEntry] = []
+        self.log_start = 1      # raft index of log[0]
+        self.snap_index = 0
+        self.snap_term = 0
+        self.commit_index = 0
+        self.store = Store()
+        self.membership: list[str] = list(membership)
+        self.leases: dict[int, int] = {}     # lease id -> ttl (applied state)
+        # leader volatile
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+        self.lease_expiry: dict[int, int] = {}
+        self.waiters: dict[int, tuple[int, Future]] = {}  # index->(term,fut)
+        # durability (bytes on "disk")
+        self.wal_current = b""
+        self.wal_durable = b""
+        self.snap_current = b""
+        self.snap_durable = b""
+        self.applied_since_snap = 0
+        # observability
+        self.etcd_log: list[str] = []
+        self.resume_event: Optional[SimEvent] = None
+        self.watchers: list = []  # Watcher objects served by this node
+        self.store_applied_index = 0
+
+    # ---- small helpers ----------------------------------------------------
+
+    @property
+    def loop(self) -> SimLoop:
+        return self.cluster.loop
+
+    def clock(self) -> int:
+        return self.loop.now + self.clock_offset
+
+    def log_line(self, msg: str) -> None:
+        self.etcd_log.append(
+            f"{{\"ts\":{self.loop.now / SECOND:.3f},\"msg\":{msg!r}}}")
+
+    def last_index(self) -> int:
+        return self.log_start + len(self.log) - 1 if self.log else self.snap_index
+
+    def last_term(self) -> int:
+        return self.log[-1].term if self.log else self.snap_term
+
+    def entry(self, index: int) -> Optional[LogEntry]:
+        i = index - self.log_start
+        return self.log[i] if 0 <= i < len(self.log) else None
+
+    def majority(self) -> int:
+        return len(self.membership) // 2 + 1
+
+    def reset_election_deadline(self) -> None:
+        jitter = self.loop.rng.randint(0, self.cluster.cfg.election_timeout)
+        self.election_deadline = (self.loop.now +
+                                  self.cluster.cfg.election_timeout + jitter)
+
+    # ---- durability -------------------------------------------------------
+
+    def wal_append(self, e: LogEntry) -> None:
+        self.wal_current = walmod.append_record(
+            self.wal_current, (e.index, e.term, e.kind, e.payload))
+        if not self.cluster.cfg.unsafe_no_fsync:
+            self.wal_durable = self.wal_current
+
+    def fsync(self) -> None:
+        self.wal_durable = self.wal_current
+        self.snap_durable = self.snap_current
+
+    def maybe_snapshot(self) -> None:
+        if self.applied_since_snap < self.cluster.cfg.snapshot_count:
+            return
+        applied = self.commit_index
+        self.snap_index = applied
+        ent = self.entry(applied)
+        self.snap_term = ent.term if ent else self.term
+        snap = (applied, self.snap_term, self.store.clone(),
+                list(self.membership), dict(self.leases))
+        self.snap_current = walmod.encode_records([snap])
+        # drop the log prefix; rebuild the WAL from the snapshot point
+        keep = [e for e in self.log if e.index > applied]
+        self.log = keep
+        self.log_start = applied + 1
+        self.wal_current = walmod.encode_records(
+            [(e.index, e.term, e.kind, e.payload) for e in keep])
+        self.fsync()  # etcd fsyncs snapshots even with --unsafe-no-fsync
+        self.applied_since_snap = 0
+        self.log_line(f"saved snapshot at index {applied}")
+
+    # ---- state machine ----------------------------------------------------
+
+    def apply_up_to_commit(self) -> None:
+        while self.store_applied_index < self.commit_index:
+            idx = self.store_applied_index + 1
+            e = self.entry(idx)
+            if e is None:
+                break  # entry compacted away / missing (snapshot pending)
+            self._apply(e)
+            self.store_applied_index = idx
+            self.applied_since_snap += 1
+        self.maybe_snapshot()
+
+    def _apply(self, e: LogEntry) -> None:
+        result = None
+        if e.kind == "txn":
+            result = self.store.apply_txn(e.payload)
+            if result["events"]:
+                self._notify_watchers(result["events"])
+        elif e.kind == "compact":
+            try:
+                self.store.compact(e.payload)
+            except SimError:
+                pass
+        elif e.kind == "member_add":
+            if e.payload not in self.membership:
+                self.membership.append(e.payload)
+            self.log_line(f"added member {e.payload}")
+        elif e.kind == "member_remove":
+            if e.payload in self.membership:
+                self.membership.remove(e.payload)
+            self.log_line(f"removed member {e.payload}")
+            if e.payload == self.name:
+                self.removed = True
+                self.role = "follower"
+            else:
+                # conf-change broadcast: the removed member learns and
+                # shuts its raft ("raft: stopped", client.clj:322-323)
+                victim = self.cluster.nodes.get(e.payload)
+                if victim is not None and victim.alive:
+                    victim.removed = True
+                    victim.role = "follower"
+                    victim.membership = [m for m in victim.membership
+                                         if m != e.payload]
+                    victim.log_line("raft: stopped (removed from cluster)")
+        elif e.kind == "lease_grant":
+            lid, ttl = e.payload
+            self.leases[lid] = ttl
+            if self.role == "leader":
+                self.lease_expiry.setdefault(lid, self.clock() + ttl)
+        elif e.kind == "lease_revoke":
+            lid = e.payload
+            self.leases.pop(lid, None)
+            self.lease_expiry.pop(lid, None)
+            keys = sorted(self.store.lease_keys.get(lid, set()))
+            if keys:
+                res = self.store.apply_txn(
+                    Txn((), tuple(("delete", k) for k in keys), ()))
+                if res["events"]:
+                    self._notify_watchers(res["events"])
+            self.store.lease_keys.pop(lid, None)
+        # resolve the proposer's waiter
+        w = self.waiters.pop(e.index, None)
+        if w is not None:
+            wterm, fut = w
+            if wterm == e.term:
+                fut.set_result(result)
+            else:
+                fut.set_exception(SimError("leader-changed",
+                                           "entry overwritten"))
+
+    def _notify_watchers(self, events: list[Event]) -> None:
+        for w in list(self.watchers):
+            w.feed(events)
+
+class Watcher:
+    """A watch stream served by one node (client.clj:663-693 surface)."""
+
+    def __init__(self, node: Node, key: str, from_rev: int,
+                 on_events: Callable, on_error: Callable,
+                 prefix: bool = False):
+        self.node = node
+        self.key = key
+        self.prefix = prefix
+        self.next_rev = from_rev
+        self.on_events = on_events
+        self.on_error = on_error
+        self.closed = False
+        # A watch is ONE ordered stream: deliveries form a FIFO chain so
+        # random per-batch latencies can never reorder events
+        # (the nonmonotonic-revision check at watch.clj:161-177 relies on
+        # stream order; reordering here would be a false SUT bug).
+        self._outbox: list[list[Event]] = []
+        self._draining = False
+
+    def matches(self, ev: Event) -> bool:
+        return (ev.key.startswith(self.key) if self.prefix
+                else ev.key == self.key)
+
+    def feed(self, events: list[Event]) -> None:
+        if self.closed:
+            return
+        evs = [e for e in events
+               if self.matches(e) and e.revision >= self.next_rev]
+        if not evs:
+            return
+        self.next_rev = max(e.revision for e in evs) + 1
+        self._outbox.append(evs)
+        if not self._draining:
+            self._draining = True
+            delay = self.node.loop.rng.randint(
+                *self.node.cluster.cfg.rpc_delay)
+            self.node.loop.call_later(delay, self._drain)
+
+    def _drain(self) -> None:
+        if self.closed or not self.node.alive:
+            self._draining = False
+            return  # stream broken; kill_node cancels with an error
+        if self.node.paused:
+            # SIGSTOP: the kernel buffers the stream; deliver after resume.
+            self.node.loop.call_later(self.node.cluster.cfg.tick,
+                                      self._drain)
+            return
+        while self._outbox:
+            self.on_events(self._outbox.pop(0))
+        self._draining = False
+
+    def cancel(self, error: Optional[SimError] = None) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        if self in self.node.watchers:
+            self.node.watchers.remove(self)
+        if error is not None:
+            self.on_error(error)
+
+
+class Cluster:
+    """The simulated cluster + fault API. One instance per test."""
+
+    def __init__(self, loop: SimLoop, node_names: list[str],
+                 cfg: Optional[ClusterConfig] = None):
+        self.loop = loop
+        self.cfg = cfg or ClusterConfig()
+        self.initial_names = list(node_names)
+        self.nodes: dict[str, Node] = {
+            n: Node(n, self, node_names) for n in node_names}
+        self.blocked_pairs: set[frozenset] = set()
+        self.running = False
+        self._tick_task = None
+        self.next_lease_id = 0x70000000
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def launch(self) -> None:
+        self.running = True
+        for n in self.nodes.values():
+            if not n.alive:
+                self.start_node(n.name, fresh=True)
+        self._tick_task = self.loop.spawn(self._tick_loop(), "cluster-tick")
+
+    def shutdown(self) -> None:
+        self.running = False
+        for n in self.nodes.values():
+            n.alive = False
+
+    async def _tick_loop(self) -> None:
+        while self.running:
+            await sleep(self.cfg.tick)
+            for n in list(self.nodes.values()):
+                if not n.alive or n.paused or n.removed:
+                    continue
+                if n.role == "leader":
+                    self._leader_tick(n)
+                elif self.loop.now >= n.election_deadline:
+                    self._start_election(n)
+
+    # ---- connectivity -----------------------------------------------------
+
+    def reachable(self, a: str, b: str) -> bool:
+        if a == b:
+            return True
+        na, nb = self.nodes.get(a), self.nodes.get(b)
+        if na is None or nb is None:
+            return False
+        if not (na.alive and nb.alive) or na.paused or nb.paused:
+            return False
+        return frozenset((a, b)) not in self.blocked_pairs
+
+    def visible_majority(self, node: Node) -> bool:
+        peers = [m for m in node.membership]
+        up = sum(1 for m in peers if self.reachable(node.name, m))
+        return up >= node.majority()
+
+    # ---- elections & replication ------------------------------------------
+
+    def _start_election(self, cand: Node) -> None:
+        cand.term += 1
+        cand.voted_for = cand.name
+        cand.role = "candidate"
+        cand.reset_election_deadline()
+        votes = 1
+        for m in cand.membership:
+            if m == cand.name or not self.reachable(cand.name, m):
+                continue
+            peer = self.nodes.get(m)
+            if peer is None or peer.removed:
+                continue
+            if peer.term > cand.term:
+                cand.term = peer.term
+                cand.role = "follower"
+                return
+            up_to_date = (cand.last_term(), cand.last_index()) >= \
+                         (peer.last_term(), peer.last_index())
+            if peer.term < cand.term:
+                peer.term = cand.term
+                peer.voted_for = None
+                if peer.role != "follower":
+                    peer.role = "follower"
+            if peer.voted_for in (None, cand.name) and up_to_date:
+                peer.voted_for = cand.name
+                peer.reset_election_deadline()
+                votes += 1
+        if votes >= cand.majority():
+            self._become_leader(cand)
+
+    def _become_leader(self, n: Node) -> None:
+        n.role = "leader"
+        n.leader_hint = n.name
+        n.last_quorum_contact = self.loop.now
+        n.next_index = {m: n.last_index() + 1 for m in n.membership}
+        n.match_index = {m: 0 for m in n.membership}
+        # fresh full TTL for every applied lease (etcd leader-change behavior
+        # — the reason lock tests must fail, lock.clj)
+        n.lease_expiry = {lid: n.clock() + ttl
+                          for lid, ttl in n.leases.items()}
+        n.log_line(f"elected leader at term {n.term}")
+        logger.debug("%s elected leader term %d", n.name, n.term)
+        self._append_entry(n, "noop", None)
+        self._leader_tick(n)
+
+    def _append_entry(self, leader: Node, kind: str, payload: Any,
+                      fut: Optional[Future] = None) -> LogEntry:
+        e = LogEntry(index=leader.last_index() + 1, term=leader.term,
+                     kind=kind, payload=payload)
+        leader.log.append(e)
+        leader.wal_append(e)
+        if fut is not None:
+            leader.waiters[e.index] = (e.term, fut)
+        self._replicate_now(leader)
+        return e
+
+    def _replicate_now(self, leader: Node) -> None:
+        for m in leader.membership:
+            if m == leader.name:
+                continue
+            self.loop.spawn(self._send_append(leader, m), "repl")
+        self._advance_commit(leader)
+
+    def _leader_tick(self, leader: Node) -> None:
+        # check-quorum: a partitioned leader steps down
+        if not self.visible_majority(leader):
+            if (self.loop.now - leader.last_quorum_contact >
+                    self.cfg.election_timeout):
+                leader.role = "follower"
+                leader.reset_election_deadline()
+                leader.log_line("lost quorum; stepping down")
+                self._fail_waiters(leader, SimError(
+                    "leader-changed", "lost quorum"))
+                return
+        else:
+            leader.last_quorum_contact = self.loop.now
+        self._replicate_now(leader)
+        self._expire_leases(leader)
+
+    async def _send_append(self, leader: Node, peer_name: str) -> None:
+        await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+        peer = self.nodes.get(peer_name)
+        if (peer is None or leader.role != "leader" or not leader.alive
+                or not self.reachable(leader.name, peer_name)
+                or peer.removed):
+            return
+        if peer.term > leader.term:
+            leader.term = peer.term
+            leader.role = "follower"
+            leader.voted_for = None
+            self._fail_waiters(leader, SimError("leader-changed",
+                                                "higher term seen"))
+            return
+        if peer.term < leader.term:
+            peer.term = leader.term
+            peer.voted_for = None
+        peer.role = "follower"
+        peer.leader_hint = leader.name
+        peer.reset_election_deadline()
+        ni = leader.next_index.get(peer_name, leader.last_index() + 1)
+        if ni < leader.log_start:
+            # peer too far behind: install snapshot
+            self._install_snapshot(leader, peer)
+            ni = leader.log_start
+        # log-matching check at ni-1
+        prev_idx = ni - 1
+        if prev_idx <= peer.snap_index or prev_idx == 0:
+            ok = True  # at/below peer's snapshot: that prefix is committed
+        else:
+            pe = peer.entry(prev_idx)
+            if pe is None:
+                ok = False  # peer's log too short: back up
+            else:
+                le = leader.entry(prev_idx)
+                expected = le.term if le is not None else (
+                    leader.snap_term if prev_idx == leader.snap_index
+                    else None)
+                ok = expected is not None and pe.term == expected
+        if not ok:
+            leader.next_index[peer_name] = max(1, ni - 1)
+            return
+        # append entries from ni
+        entries = [e for e in leader.log if e.index >= ni]
+        if entries:
+            # truncate conflicts
+            first = entries[0].index
+            conflict = None
+            for e in entries:
+                pe = peer.entry(e.index)
+                if pe is not None and pe.term != e.term:
+                    conflict = e.index
+                    break
+            if conflict is not None:
+                kept = [e for e in peer.log if e.index < conflict]
+                dropped = [e for e in peer.log if e.index >= conflict]
+                peer.log = kept
+                for d in dropped:
+                    w = peer.waiters.pop(d.index, None)
+                    if w is not None:
+                        w[1].set_exception(SimError("leader-changed",
+                                                    "entry overwritten"))
+                peer.wal_current = walmod.encode_records(
+                    [(e.index, e.term, e.kind, e.payload) for e in peer.log])
+            for e in entries:
+                if peer.entry(e.index) is None:
+                    peer.log.append(LogEntry(e.index, e.term, e.kind,
+                                             e.payload))
+                    peer.wal_append(peer.log[-1])
+            leader.next_index[peer_name] = entries[-1].index + 1
+            leader.match_index[peer_name] = entries[-1].index
+        else:
+            leader.match_index[peer_name] = max(
+                leader.match_index.get(peer_name, 0),
+                min(ni - 1, leader.last_index()))
+        # propagate commit index
+        self._advance_commit(leader)
+        new_commit = min(leader.commit_index, peer.last_index())
+        if new_commit > peer.commit_index:
+            peer.commit_index = new_commit
+            peer.apply_up_to_commit()
+
+    def _install_snapshot(self, leader: Node, peer: Node) -> None:
+        snap_items, err = walmod.decode_records(leader.snap_current)
+        if err or not snap_items:
+            # leader snapshot bytes damaged: send live state (etcd would
+            # alarm; we keep the cluster moving and log it)
+            leader.log_line("snapshot send from live state")
+            peer.store = leader.store.clone()
+            peer.membership = list(leader.membership)
+            peer.leases = dict(leader.leases)
+            peer.snap_index, peer.snap_term = leader.store_applied_index, leader.term
+            peer.store_applied_index = leader.store_applied_index
+            peer.log = []
+            peer.log_start = peer.snap_index + 1
+            peer.commit_index = peer.snap_index
+        else:
+            idx, term, store, membership, leases = snap_items[0]
+            peer.store = store.clone()
+            peer.membership = list(membership)
+            peer.leases = dict(leases)
+            peer.snap_index, peer.snap_term = idx, term
+            peer.store_applied_index = idx
+            peer.log = []
+            peer.log_start = idx + 1
+            peer.commit_index = idx
+        peer.snap_current = leader.snap_current
+        peer.wal_current = b""
+        peer.fsync()
+        peer.applied_since_snap = 0
+        peer.log_line(f"installed snapshot at index {peer.snap_index}")
+
+    def _advance_commit(self, leader: Node) -> None:
+        if leader.role != "leader":
+            return
+        for idx in range(leader.last_index(), leader.commit_index, -1):
+            e = leader.entry(idx)
+            if e is None or e.term != leader.term:
+                continue  # only commit entries of own term by counting
+            votes = 0
+            for m in leader.membership:
+                if m == leader.name:
+                    votes += 1
+                elif leader.match_index.get(m, 0) >= idx:
+                    votes += 1
+            if votes >= leader.majority():
+                leader.commit_index = idx
+                leader.apply_up_to_commit()
+                break
+
+    def _fail_waiters(self, n: Node, err: SimError) -> None:
+        for idx, (_, fut) in list(n.waiters.items()):
+            fut.set_exception(err)
+        n.waiters.clear()
+
+    def _expire_leases(self, leader: Node) -> None:
+        now = leader.clock()
+        for lid, deadline in list(leader.lease_expiry.items()):
+            if now >= deadline and lid in leader.leases:
+                leader.lease_expiry.pop(lid, None)
+                leader.log_line(f"lease {lid:x} expired")
+                self.loop.spawn(self._propose_silent(
+                    leader.name, "lease_revoke", lid), "lease-expire")
+
+    async def _propose_silent(self, leader_name: str, kind: str,
+                              payload: Any) -> None:
+        try:
+            await self.propose(leader_name, kind, payload)
+        except SimError:
+            pass
+
+    # ---- proposals (leader-side) ------------------------------------------
+
+    async def propose(self, node_name: str, kind: str, payload: Any) -> Any:
+        """Propose an entry at node (must be leader); resolves at apply."""
+        n = self.nodes[node_name]
+        if n.role != "leader" or not n.alive:
+            raise SimError("not-leader", node_name)
+        fut = self.loop.future()
+        self._append_entry(n, kind, payload, fut)
+        return await fut
+
+    def current_leader_visible(self, from_node: Node) -> Optional[Node]:
+        """The leader as discoverable from this node (via its raft links)."""
+        # direct knowledge
+        for name in [from_node.leader_hint] + list(from_node.membership):
+            if name is None:
+                continue
+            ln = self.nodes.get(name)
+            if (ln is not None and ln.alive and not ln.paused
+                    and ln.role == "leader"
+                    and self.reachable(from_node.name, name)):
+                return ln
+        return None
+
+    # ---- client RPC surface ------------------------------------------------
+
+    async def _enter(self, node_name: str) -> Node:
+        """Client dial + request leg."""
+        n = self.nodes.get(node_name)
+        if n is None:
+            raise SimError("unavailable", f"unknown node {node_name}")
+        await sleep(self.loop.rng.randint(*self.cfg.rpc_delay))
+        if not n.alive:
+            raise SimError("connect-failed", node_name)
+        if n.removed:
+            raise SimError("raft-stopped", node_name)
+        if n.paused:
+            # SIGSTOP: the TCP connection hangs; wait for resume
+            if n.resume_event is None:
+                n.resume_event = SimEvent(self.loop)
+            await n.resume_event.wait()
+            if not n.alive:
+                raise SimError("connect-failed", node_name)
+        return n
+
+    async def _at_leader(self, node: Node) -> Node:
+        """Forward to the leader, waiting through elections (until the
+        caller's timeout cancels us)."""
+        while True:
+            if node.role == "leader":
+                return node
+            leader = self.current_leader_visible(node)
+            if leader is not None:
+                await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+                return leader
+            await sleep(self.cfg.heartbeat_interval)
+            if not node.alive:
+                raise SimError("unavailable", node.name)
+
+    async def kv_txn(self, node_name: str, txn: Txn) -> dict:
+        """Linearizable If/Then/Else transaction (client.clj:464-485)."""
+        n = await self._enter(node_name)
+        leader = await self._at_leader(n)
+        result = await self.propose(leader.name, "txn", txn)
+        await sleep(self.loop.rng.randint(*self.cfg.rpc_delay))
+        return result
+
+    async def kv_read(self, node_name: str, key: str,
+                      serializable: bool = False) -> dict:
+        """Reads: serializable = node-local (stale allowed, register.clj:26-28
+        with :serializable); default linearizable via leader read-index."""
+        n = await self._enter(node_name)
+        if serializable:
+            return {"kv": n.store.get(key), "revision": n.store.revision}
+        leader = await self._at_leader(n)
+        await self._read_index(leader)
+        out = {"kv": leader.store.get(key), "revision": leader.store.revision}
+        await sleep(self.loop.rng.randint(*self.cfg.rpc_delay))
+        return out
+
+    async def _read_index(self, leader: Node) -> None:
+        """Quorum round before serving a linearizable read."""
+        await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+        while not (leader.role == "leader" and self.visible_majority(leader)):
+            if not leader.alive:
+                raise SimError("unavailable", leader.name)
+            await sleep(self.cfg.heartbeat_interval)
+            if leader.role != "leader":
+                raise SimError("leader-changed", leader.name)
+
+    async def range_read(self, node_name: str, prefix: str,
+                         serializable: bool = False) -> list[dict]:
+        n = await self._enter(node_name)
+        if serializable:
+            return n.store.range_prefix(prefix)
+        leader = await self._at_leader(n)
+        await self._read_index(leader)
+        return leader.store.range_prefix(prefix)
+
+    # ---- leases ------------------------------------------------------------
+
+    async def lease_grant(self, node_name: str, ttl_ns: int) -> int:
+        n = await self._enter(node_name)
+        leader = await self._at_leader(n)
+        self.next_lease_id += self.loop.rng.randint(1, 1000)
+        lid = self.next_lease_id
+        await self.propose(leader.name, "lease_grant", (lid, ttl_ns))
+        return lid
+
+    async def lease_revoke(self, node_name: str, lid: int) -> None:
+        n = await self._enter(node_name)
+        leader = await self._at_leader(n)
+        if lid not in leader.leases:
+            raise SimError("lease-not-found", f"{lid:x}")
+        await self.propose(leader.name, "lease_revoke", lid)
+
+    async def lease_keepalive(self, node_name: str, lid: int) -> int:
+        """Refresh; returns granted ttl (client.clj:544-554 keepalive)."""
+        n = await self._enter(node_name)
+        leader = await self._at_leader(n)
+        ttl = leader.leases.get(lid)
+        if ttl is None:
+            raise SimError("lease-not-found", f"{lid:x}")
+        leader.lease_expiry[lid] = leader.clock() + ttl
+        return ttl
+
+    # ---- locks (etcd lock service semantics) --------------------------------
+
+    async def lock(self, node_name: str, name: str, lid: int) -> str:
+        """Acquire: create name/<lease> key, wait until first in queue."""
+        n = await self._enter(node_name)
+        leader = await self._at_leader(n)
+        if lid not in leader.leases:
+            raise SimError("lease-not-found", f"{lid:x}")
+        key = f"__lock__/{name}/{lid:x}"
+        await self.propose(leader.name, "txn", Txn(
+            cmps=(("=", key, "version", 0),),
+            then_ops=(("put", key, lid, lid),),
+            else_ops=(("get", key),)))
+        while True:
+            waiters = await self.range_read(node_name,
+                                           f"__lock__/{name}/")
+            mine = [kv for kv in waiters if kv["key"] == key]
+            if not mine:
+                raise SimError("lease-not-found",
+                               f"lock key lost (lease {lid:x} expired?)")
+            if min(waiters, key=lambda kv: kv["create-revision"])["key"] == key:
+                return key
+            await sleep(self.cfg.heartbeat_interval)
+
+    async def unlock(self, node_name: str, lock_key: str) -> None:
+        n = await self._enter(node_name)
+        leader = await self._at_leader(n)
+        res = await self.propose(leader.name, "txn",
+                                 Txn((), (("delete", lock_key),), ()))
+        deleted = res["results"][0][1]
+        if not deleted:
+            raise SimError("not-held", lock_key)
+
+    # ---- watches ------------------------------------------------------------
+
+    def watch(self, node_name: str, key: str, from_rev: int,
+              on_events: Callable, on_error: Callable) -> Watcher:
+        """Open a watch stream on a node from a revision
+        (client.clj:663-693). Synchronous registration; catch-up events
+        are delivered asynchronously."""
+        n = self.nodes.get(node_name)
+        if n is None or not n.alive:
+            raise SimError("connect-failed", node_name)
+        w = Watcher(n, key, from_rev, on_events, on_error)
+        try:
+            backlog = n.store.events_since(from_rev)
+        except SimError as e:
+            self.loop.call_soon(on_error, e)
+            return w
+        n.watchers.append(w)
+        if backlog:
+            w.next_rev = max(e.revision for e in backlog) + 1
+            w._outbox.append(backlog)
+            w._draining = True
+            delay = self.loop.rng.randint(*self.cfg.rpc_delay)
+            self.loop.call_later(delay, w._drain)
+        return w
+
+    # ---- maintenance / status ----------------------------------------------
+
+    async def status(self, node_name: str) -> dict:
+        n = await self._enter(node_name)
+        return {
+            "node": n.name,
+            "leader": n.leader_hint,
+            "raft-term": n.term,
+            "raft-index": n.last_index(),
+            "revision": n.store.revision,
+            "db-size": len(n.wal_current) + len(n.snap_current),
+            "member-count": len(n.membership),
+            "is-leader": n.role == "leader",
+        }
+
+    async def compact(self, node_name: str, rev: int,
+                      physical: bool = False) -> None:
+        n = await self._enter(node_name)
+        leader = await self._at_leader(n)
+        if rev <= leader.store.compact_revision:
+            raise SimError("compacted", f"{rev} already compacted")
+        if rev > leader.store.revision:
+            raise SimError("compacted", f"{rev} is a future revision")
+        await self.propose(leader.name, "compact", rev)
+        if physical:
+            await sleep(10 * MS)
+
+    async def defrag(self, node_name: str) -> None:
+        n = await self._enter(node_name)
+        await sleep(self.loop.rng.randint(50 * MS, 200 * MS))
+        n.log_line("defragmented")
+
+    # ---- membership ---------------------------------------------------------
+
+    async def member_list(self, node_name: str) -> list[str]:
+        n = await self._enter(node_name)
+        return list(n.membership)
+
+    async def member_add(self, via_node: str, new_name: str) -> None:
+        n = await self._enter(via_node)
+        leader = await self._at_leader(n)
+        if new_name in leader.membership:
+            raise SimError("duplicate-key", new_name)
+        await self.propose(leader.name, "member_add", new_name)
+
+    async def member_remove(self, via_node: str, name: str) -> None:
+        n = await self._enter(via_node)
+        leader = await self._at_leader(n)
+        if name not in leader.membership:
+            raise SimError("member-not-found", name)
+        await self.propose(leader.name, "member_remove", name)
+
+    # ---- fault API (driven by the nemesis / db layers) ----------------------
+
+    def kill_node(self, name: str, lose_unfsynced: bool = False) -> None:
+        n = self.nodes[name]
+        if not n.alive:
+            return
+        n.alive = False
+        n.paused = False
+        n.role = "follower"
+        n.log_line("received signal; shutting down (killed)")
+        self._fail_waiters(n, SimError("unavailable", "node killed"))
+        for w in list(n.watchers):
+            w.cancel(SimError("unavailable", "node killed"))
+        if lose_unfsynced or (self.cfg.lazyfs and self.cfg.unsafe_no_fsync):
+            n.wal_current = n.wal_durable
+            n.snap_current = n.snap_durable
+        if n.resume_event is not None:
+            n.resume_event.set()
+            n.resume_event = None
+
+    def start_node(self, name: str, fresh: bool = False,
+                   initial_membership: Optional[list] = None) -> None:
+        """(Re)start a node, recovering from its durable files.
+
+        Raises SimError("corrupt") and logs a panic if the WAL or snapshot
+        bytes are damaged in a committed region.
+        """
+        n = self.nodes.get(name)
+        if n is None:
+            n = Node(name, self,
+                     initial_membership or self.initial_names)
+            self.nodes[name] = n
+        if n.alive:
+            return
+        if fresh:
+            n.wal_current = n.wal_durable = b""
+            n.snap_current = n.snap_durable = b""
+            n.log = []
+            n.log_start = 1
+            n.snap_index = n.snap_term = 0
+            n.store = Store()
+            n.store_applied_index = 0
+            n.commit_index = 0
+            n.term = 0
+            n.membership = list(initial_membership or self.initial_names)
+            n.leases = {}
+        else:
+            self._recover(n)
+        n.alive = True
+        n.paused = False
+        n.removed = name not in n.membership
+        n.role = "follower"
+        n.voted_for = None
+        n.leader_hint = None
+        n.waiters = {}
+        n.watchers = []
+        n.applied_since_snap = 0
+        n.reset_election_deadline()
+        n.log_line("etcd server started")
+
+    def _recover(self, n: Node) -> None:
+        # snapshot
+        snap_items, snap_err = walmod.decode_records(n.snap_current)
+        if snap_err == "crc-mismatch":
+            n.log_line("panic: snap: crc mismatch, cannot load snapshot")
+            raise SimError("corrupt", f"{n.name} snapshot corrupt")
+        if snap_items:
+            idx, term, store, membership, leases = snap_items[0]
+            n.store = store.clone()
+            n.membership = list(membership)
+            n.leases = dict(leases)
+            n.snap_index, n.snap_term = idx, term
+            n.store_applied_index = idx
+            n.log_start = idx + 1
+        else:
+            n.store = Store()
+            n.store_applied_index = 0
+            n.snap_index = n.snap_term = 0
+            n.log_start = 1
+            n.membership = list(self.initial_names)
+            n.leases = {}
+        # wal
+        items, err = walmod.decode_records(n.wal_current)
+        if err == "crc-mismatch":
+            n.log_line("panic: walpb: crc mismatch")
+            raise SimError("corrupt", f"{n.name} WAL corrupt")
+        # torn-record at the tail is tolerated (mid-write crash)
+        n.log = [LogEntry(i, t, k, p) for (i, t, k, p) in items
+                 if i >= n.log_start]
+        n.wal_current = walmod.encode_records(
+            [(e.index, e.term, e.kind, e.payload) for e in n.log])
+        n.term = max([n.snap_term] + [e.term for e in n.log])
+        # conservative: nothing beyond the snapshot is known committed;
+        # the leader's replication will re-advance commit_index.
+        n.commit_index = n.snap_index
+
+    def pause_node(self, name: str) -> None:
+        n = self.nodes[name]
+        if n.alive:
+            n.paused = True
+            n.log_line("paused (SIGSTOP)")
+
+    def resume_node(self, name: str) -> None:
+        n = self.nodes[name]
+        n.paused = False
+        n.log_line("resumed (SIGCONT)")
+        if n.resume_event is not None:
+            n.resume_event.set()
+            n.resume_event = None
+        n.reset_election_deadline()
+
+    def partition(self, groups: list[list[str]]) -> None:
+        """Partition nodes into isolated groups."""
+        self.blocked_pairs = set()
+        group_of = {}
+        for gi, g in enumerate(groups):
+            for name in g:
+                group_of[name] = gi
+        names = list(self.nodes)
+        for i, a in enumerate(names):
+            for b in names[i + 1:]:
+                if group_of.get(a) != group_of.get(b):
+                    self.blocked_pairs.add(frozenset((a, b)))
+
+    def heal_partition(self) -> None:
+        self.blocked_pairs = set()
+
+    def bump_clock(self, name: str, delta_ns: int) -> None:
+        self.nodes[name].clock_offset += delta_ns
+
+    def corrupt_file(self, name: str, which: str = "wal",
+                     mode: str = "bitflip", probability: float = 1e-4,
+                     truncate_bytes: int = 1024) -> None:
+        """Damage durable bytes (nemesis.clj:159-198)."""
+        n = self.nodes[name]
+        buf = n.wal_current if which == "wal" else n.snap_current
+        if mode == "bitflip":
+            buf = walmod.bitflip(buf, self.loop.rng, probability)
+        else:
+            buf = walmod.truncate(buf, self.loop.rng, truncate_bytes)
+        if which == "wal":
+            n.wal_current = n.wal_durable = buf
+        else:
+            n.snap_current = n.snap_durable = buf
+        n.log_line(f"file corrupted: {which} ({mode})")
+
+    def wipe_node(self, name: str) -> None:
+        """Remove all durable state (db.clj:29-36 wipe!)."""
+        n = self.nodes[name]
+        n.wal_current = n.wal_durable = b""
+        n.snap_current = n.snap_durable = b""
+
+    # ---- invariants ---------------------------------------------------------
+
+    def consistency_report(self) -> dict:
+        """Cross-node applied-state fingerprint comparison (the analog of
+        etcd's corruption alarm)."""
+        fps = {}
+        for name, n in self.nodes.items():
+            fps[name] = {"applied": n.store_applied_index,
+                         "revision": n.store.revision,
+                         "fingerprint": n.store.state_fingerprint()}
+        return fps

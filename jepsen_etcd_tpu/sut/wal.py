@@ -1,0 +1,99 @@
+"""Byte-level WAL and snapshot model for corruption faults.
+
+The reference's corruption nemesis bitflips or truncates real etcd WAL/snap
+files on disk (``nemesis.clj:145-198``), and etcd reacts by panicking on
+CRC mismatch at replay. Our simulated nodes keep an actual byte buffer per
+"file" with per-record CRCs so the same fault surface exists: flipping a
+bit corrupts exactly one record's CRC; truncating drops tail records;
+replay stops at the first bad record (etcd WAL semantics) or — if a
+*committed* record is damaged — the node refuses to start with a panic in
+its log (cf. the log-file-pattern crash checker, etcd.clj:134-140).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import zlib
+from typing import Any, Optional
+
+
+MAGIC = b"WALR"
+
+
+def encode_records(items: list[Any]) -> bytes:
+    """Encode items as length+crc framed records."""
+    out = bytearray()
+    for item in items:
+        payload = pickle.dumps(item, protocol=4)
+        crc = zlib.crc32(payload)
+        out += MAGIC + struct.pack("<II", len(payload), crc) + payload
+    return bytes(out)
+
+
+def append_record(buf: bytes, item: Any) -> bytes:
+    payload = pickle.dumps(item, protocol=4)
+    crc = zlib.crc32(payload)
+    return buf + MAGIC + struct.pack("<II", len(payload), crc) + payload
+
+
+def decode_records(buf: bytes) -> tuple[list[Any], Optional[str]]:
+    """Decode records until the first damaged one.
+
+    Returns (items, error) where error is None for a clean read,
+    "crc-mismatch" for a corrupted record, "torn-record" for a truncated
+    tail (etcd tolerates a torn final record: it was mid-write at crash).
+    """
+    items: list[Any] = []
+    at = 0
+    n = len(buf)
+    while at < n:
+        if at + 12 > n:
+            return items, "torn-record"
+        if buf[at:at + 4] != MAGIC:
+            return items, "crc-mismatch"
+        ln, crc = struct.unpack("<II", buf[at + 4:at + 12])
+        if at + 12 + ln > n:
+            return items, "torn-record"
+        payload = buf[at + 12:at + 12 + ln]
+        if zlib.crc32(payload) != crc:
+            return items, "crc-mismatch"
+        try:
+            items.append(pickle.loads(payload))
+        except Exception:
+            return items, "crc-mismatch"
+        at += 12 + ln
+    return items, None
+
+
+def bitflip(buf: bytes, rng, probability: float) -> bytes:
+    """Flip each bit independently with the given probability
+    (nemesis.clj:183 uses probabilities 1e-3..1e-5)."""
+    if not buf:
+        return buf
+    out = bytearray(buf)
+    # Expected flips = len*8*p; sample flip positions directly.
+    nbits = len(out) * 8
+    import math
+    k = 0
+    # Binomial sample via repeated geometric skips (cheap, deterministic).
+    pos = -1
+    while True:
+        if probability <= 0:
+            break
+        r = rng.random()
+        skip = int(math.log(max(r, 1e-12)) / math.log(1 - probability)) + 1
+        pos += skip
+        if pos >= nbits:
+            break
+        out[pos // 8] ^= 1 << (pos % 8)
+        k += 1
+    return bytes(out)
+
+
+def truncate(buf: bytes, rng, max_bytes: int = 1024) -> bytes:
+    """Drop up to max_bytes from the tail (nemesis.clj:182)."""
+    if not buf:
+        return buf
+    drop = rng.randint(1, max_bytes)
+    return buf[:max(0, len(buf) - drop)]

@@ -173,3 +173,34 @@ def test_determinism_full_stack():
         return run_gen(gen, concurrency=4, seed=123).to_jsonl()
 
     assert once_run() == once_run()
+
+
+def test_fngen_finite_source_no_loss():
+    # Regression: a stateful fn source must not lose ops while threads busy.
+    items = list(range(12))
+
+    def src(test, ctx):
+        return {"f": "item", "value": items.pop(0)} if items else None
+
+    h = run_gen(src, concurrency=2, latency=int(0.2 * SECOND))
+    vals = sorted(op.value for op in h.invokes())
+    assert vals == list(range(12))
+
+
+def test_explicit_process_busy_thread_no_loss():
+    # Regression: ops pinned to a busy thread queue up instead of dropping.
+    h = run_gen(limit(5, repeat({"f": "ping", "process": 0})), concurrency=2,
+                latency=int(0.1 * SECOND))
+    assert len([op for op in h.invokes() if op.f == "ping"]) == 5
+
+
+def test_reserve_exact_thread_count_terminates():
+    # Regression: reserve consuming all threads must terminate (no empty
+    # default branch pending forever).
+    gen = reserve(2, limit(4, repeat({"f": "a"})),
+                  limit(4, repeat({"f": "b"})))
+    h = run_gen(gen, concurrency=2)  # counts sum to concurrency... 2+default
+    # here: 2 reserved for "a", default "b" gets zero threads -> branch
+    # omitted; only "a" ops run
+    assert len([op for op in h.invokes() if op.f == "a"]) == 4
+    assert len([op for op in h.invokes() if op.f == "b"]) == 0

@@ -231,3 +231,25 @@ def test_queue_reroute_wakes_other_getter(loop):
         assert len(q) == 0
 
     loop.run_coro(main())
+
+
+def test_gather_child_cancel_does_not_kill_gatherer(loop):
+    # Regression: a cancelled child is a child failure, not our cancellation.
+    async def hang():
+        await loop.future()
+
+    async def quick():
+        await sleep(1)
+        return "ok"
+
+    async def main():
+        t1 = loop.spawn(hang())
+        t2 = loop.spawn(quick())
+        g = loop.spawn(gather(t1, t2))
+        await sleep(2)
+        t1.cancel()
+        with pytest.raises(Cancelled):
+            await g
+        assert t2.done and t2.result() == "ok"
+
+    loop.run_coro(main())
