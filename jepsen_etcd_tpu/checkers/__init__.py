@@ -1,0 +1,13 @@
+from .core import (Checker, Compose, compose, Stats, UnhandledExceptions,
+                   LogFilePattern, ClockPlot, Noop)
+from .independent import Independent, independent_checker
+from .linearizable import LinearizableChecker, linearizable, check_history
+from .perf import Perf
+from .timeline import TimelineHtml
+
+__all__ = [
+    "Checker", "Compose", "compose", "Stats", "UnhandledExceptions",
+    "LogFilePattern", "ClockPlot", "Noop", "Independent",
+    "independent_checker", "LinearizableChecker", "linearizable",
+    "check_history", "Perf", "TimelineHtml",
+]

@@ -1,0 +1,124 @@
+"""The checker protocol and the composite/stat checkers.
+
+Mirrors the jepsen.checker stack the reference composes at
+``etcd.clj:128-141``: compose{perf, clock, stats, exceptions,
+crash(log-file-pattern), workload-checker}.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter, defaultdict
+from typing import Any, Optional
+
+from ..core.history import History
+
+
+class Checker:
+    def check(self, test: Any, history, opts: Optional[dict] = None) -> dict:
+        raise NotImplementedError
+
+
+def _merge_valid(vals: list) -> Any:
+    """jepsen merge-valid: false < unknown < true."""
+    if any(v is False for v in vals):
+        return False
+    if any(v == "unknown" for v in vals):
+        return "unknown"
+    return True
+
+
+class Compose(Checker):
+    def __init__(self, checkers: dict):
+        self.checkers = checkers
+
+    def check(self, test, history, opts=None) -> dict:
+        results = {name: c.check(test, history, opts)
+                   for name, c in self.checkers.items()}
+        return {"valid?": _merge_valid([r.get("valid?") for r in
+                                        results.values()]),
+                **results}
+
+
+def compose(checkers: dict) -> Compose:
+    return Compose(checkers)
+
+
+class Stats(Checker):
+    """checker/stats: ok/fail/info counts, per f (etcd.clj:131)."""
+
+    def check(self, test, history, opts=None) -> dict:
+        h = history if isinstance(history, History) else History(history)
+        by_f: dict = defaultdict(Counter)
+        total = Counter()
+        for op in h.client_ops():
+            if op.is_completion:
+                by_f[op.f][op["type"]] += 1
+                total[op["type"]] += 1
+        # valid if every f had at least one ok (jepsen's heuristic:
+        # a workload where some op class never succeeds is suspicious)
+        valid = all(c.get("ok", 0) > 0 for c in by_f.values()) \
+            if by_f else True
+        return {"valid?": True if valid else "unknown",
+                "count": sum(total.values()),
+                "ok-count": total.get("ok", 0),
+                "fail-count": total.get("fail", 0),
+                "info-count": total.get("info", 0),
+                "by-f": {f: dict(c) for f, c in by_f.items()}}
+
+
+class UnhandledExceptions(Checker):
+    """checker/unhandled-exceptions: collect worker-crash errors
+    (etcd.clj:133)."""
+
+    def check(self, test, history, opts=None) -> dict:
+        h = history if isinstance(history, History) else History(history)
+        crashes = [dict(op) for op in h
+                   if isinstance(op.get("error"), (list, tuple))
+                   and len(op["error"]) == 2
+                   and op["error"][0] == "worker-crash"]
+        return {"valid?": True if not crashes else False,
+                "exceptions": crashes[:16],
+                "count": len(crashes)}
+
+
+class LogFilePattern(Checker):
+    """checker/log-file-pattern: scan SUT logs for crash signatures
+    (etcd.clj:134-140), with the reference's false-positive carve-out for
+    membership-change restarts ("couldn't find local name")."""
+
+    def __init__(self, pattern: str = r"panic|fatal|SIG[A-Z]+",
+                 exclude: str = r"couldn't find local name",
+                 log_file: str = "etcd.log"):
+        self.pattern = re.compile(pattern)
+        self.exclude = re.compile(exclude)
+        self.log_file = log_file
+
+    def check(self, test, history, opts=None) -> dict:
+        matches = []
+        cluster = test.get("cluster") if isinstance(test, dict) else None
+        if cluster is not None:
+            for name, node in cluster.nodes.items():
+                for line in node.etcd_log:
+                    if self.pattern.search(line) and \
+                            not self.exclude.search(line):
+                        matches.append({"node": name, "line": line})
+        return {"valid?": True if not matches else False,
+                "matches": matches[:32],
+                "count": len(matches)}
+
+
+class ClockPlot(Checker):
+    """checker/clock-plot: records clock-offset data (artifact-only)."""
+
+    def check(self, test, history, opts=None) -> dict:
+        h = history if isinstance(history, History) else History(history)
+        points = [(op.time, op.value) for op in h.nemesis_ops()
+                  if op.f in ("bump-clock", "strobe-clock", "reset-clock")
+                  and op.is_completion]
+        return {"valid?": True, "points": points[:1000]}
+
+
+class Noop(Checker):
+    def check(self, test, history, opts=None) -> dict:
+        return {"valid?": True}

@@ -1,0 +1,37 @@
+"""Per-key checker decomposition (jepsen.independent/checker,
+register.clj:108-113).
+
+Splits a (key, value)-tuple history into per-key sub-histories and runs
+the wrapped checker on each. Sub-histories preserve op indices, so
+reports point back into the full history. This is the host-side half of
+the key-level data parallelism; TPU checkers batch the same split into a
+padded tensor and vmap over it (SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+from ..core.history import History
+from ..generators.independent import history_keys, subhistory
+from .core import Checker, _merge_valid
+
+
+class Independent(Checker):
+    def __init__(self, inner: Checker):
+        self.inner = inner
+
+    def check(self, test, history, opts=None) -> dict:
+        h = history if isinstance(history, History) else History(history)
+        results = {}
+        for k in history_keys(h):
+            sub = History(subhistory(h, k))
+            results[k] = self.inner.check(test, sub, opts)
+        return {
+            "valid?": _merge_valid([r.get("valid?")
+                                    for r in results.values()]),
+            "key-count": len(results),
+            "results": results,
+        }
+
+
+def independent_checker(inner: Checker) -> Independent:
+    return Independent(inner)

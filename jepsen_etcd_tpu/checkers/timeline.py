@@ -1,0 +1,47 @@
+"""Per-process op timeline HTML (jepsen.checker.timeline, used at
+register.clj:112, lock.clj:245,259)."""
+
+from __future__ import annotations
+
+import html
+import os
+
+from ..core.history import History
+from .core import Checker
+
+SECOND = 1_000_000_000
+
+COLORS = {"ok": "#B3F3B5", "info": "#F3EAB3", "fail": "#F3B3B3"}
+
+
+class TimelineHtml(Checker):
+    def check(self, test, history, opts=None) -> dict:
+        store_dir = (opts or {}).get("store_dir")
+        if not store_dir:
+            return {"valid?": True}
+        h = history if isinstance(history, History) else History(history)
+        rows = []
+        for op in h.client_ops():
+            if not op.is_invoke:
+                continue
+            comp = h.completion(op)
+            t0 = op["time"] / SECOND
+            t1 = comp["time"] / SECOND if comp else None
+            typ = comp["type"] if comp else "info"
+            val = comp.get("value") if comp else op.get("value")
+            rows.append(
+                f"<div class='op' style='background:{COLORS.get(typ, '#ddd')}'>"
+                f"<b>{op['process']}</b> {html.escape(str(op.f))} "
+                f"{html.escape(repr(val))} "
+                f"<span class='t'>[{t0:.3f}s → "
+                f"{f'{t1:.3f}s' if t1 else '⋯'}] {typ}"
+                f"{(' ' + html.escape(repr(comp.get('error')))) if comp is not None and comp.get('error') else ''}"
+                f"</span></div>")
+        doc = ("<html><head><style>"
+               ".op{font:12px monospace;margin:1px;padding:2px}"
+               ".t{color:#666}"
+               "</style></head><body>" + "\n".join(rows) + "</body></html>")
+        path = os.path.join(store_dir, "timeline.html")
+        with open(path, "w") as f:
+            f.write(doc)
+        return {"valid?": True, "file": path}

@@ -1,0 +1,144 @@
+"""CLI: `python -m jepsen_etcd_tpu test|test-all ...`.
+
+Mirrors the reference's lein run commands and flags (etcd.clj:157-257):
+test runs one composed test; test-all sweeps nemeses x workloads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from .compose import etcd_test, default_opts
+from .workloads import workloads, WORKLOADS_EXPECTED_TO_PASS
+from .runner.test_runner import run_test
+
+ALL_NEMESES = [[], ["pause"], ["kill"], ["partition"], ["clock"],
+               ["member"], ["corrupt"], ["admin"]]  # etcd.clj:60-73
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="jepsen_etcd_tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+    for cmd in ("test", "test-all"):
+        s = sub.add_parser(cmd)
+        s.add_argument("-w", "--workload", default="register",
+                       choices=sorted(workloads().keys()))
+        s.add_argument("--nemesis", default="",
+                       help="comma-separated faults: kill,pause,partition,"
+                            "clock,member,corrupt,admin,all,none")
+        s.add_argument("--nemesis-interval", type=float, default=5.0)
+        s.add_argument("-r", "--rate", type=float, default=200.0)
+        s.add_argument("--ops-per-key", type=int, default=200)
+        s.add_argument("--time-limit", type=float, default=30.0)
+        s.add_argument("-c", "--concurrency", default=None,
+                       help="worker count; suffix n multiplies node count "
+                            "(e.g. 4n)")
+        s.add_argument("--nodes", default="n1,n2,n3,n4,n5")
+        s.add_argument("--serializable", action="store_true")
+        s.add_argument("--lazyfs", action="store_true")
+        s.add_argument("--client-type", default="direct",
+                       choices=["direct", "etcdctl"])
+        s.add_argument("--snapshot-count", type=int, default=100)
+        s.add_argument("--seed", type=int, default=0)
+        s.add_argument("--debug", action="store_true")
+        s.add_argument("--test-count", type=int, default=1)
+        s.add_argument("--only-workloads-expected-to-pass",
+                       action="store_true")
+        s.add_argument("--store", default="store")
+    return p
+
+
+SPECIAL_NEMESES = {  # etcd.clj:75-80
+    "none": [],
+    "all": ["pause", "kill", "partition", "clock", "member"],
+}
+
+
+def parse_nemesis_spec(spec: str) -> list[str]:
+    out: list[str] = []
+    for tok in filter(None, (t.strip() for t in spec.split(","))):
+        out.extend(SPECIAL_NEMESES.get(tok, [tok]))
+    return sorted(set(out))
+
+
+def opts_from_args(args) -> dict:
+    nodes = [n.strip() for n in args.nodes.split(",") if n.strip()]
+    conc = args.concurrency
+    if isinstance(conc, str):
+        if conc.endswith("n"):
+            conc = int(conc[:-1] or 1) * len(nodes)
+        else:
+            conc = int(conc)
+    return {
+        "nodes": nodes,
+        "workload": args.workload,
+        "nemesis": parse_nemesis_spec(args.nemesis),
+        "nemesis_interval": args.nemesis_interval,
+        "rate": args.rate,
+        "ops_per_key": args.ops_per_key,
+        "time_limit": args.time_limit,
+        "concurrency": conc,
+        "serializable": args.serializable,
+        "lazyfs": args.lazyfs,
+        "client_type": args.client_type,
+        "snapshot_count": args.snapshot_count,
+        "seed": args.seed,
+        "debug": args.debug,
+        "store_base": args.store,
+    }
+
+
+def run_one(opts: dict) -> dict:
+    test = etcd_test(opts)
+    out = run_test(test)
+    print(json.dumps({
+        "test": test["name"],
+        "valid?": out["valid?"],
+        "ops": len(out["history"]),
+        "sim-seconds": round(out["sim-seconds"], 1),
+        "wall-seconds": round(out["wall-seconds"], 2),
+        "dir": out["dir"],
+    }))
+    return out
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    args = build_parser().parse_args(argv)
+    if args.command == "test":
+        opts = opts_from_args(args)
+        ok = True
+        for i in range(args.test_count):
+            opts["seed"] = args.seed + i
+            out = run_one(dict(opts))
+            ok = ok and out["valid?"] is True
+        return 0 if ok else 1
+    # test-all: nemeses x workloads sweep (all-tests, etcd.clj:226-244)
+    base = opts_from_args(args)
+    wls = WORKLOADS_EXPECTED_TO_PASS if args.only_workloads_expected_to_pass \
+        else sorted(workloads().keys())
+    failures = []
+    for nem in ALL_NEMESES:
+        for wl in wls:
+            for i in range(args.test_count):
+                opts = dict(base)
+                opts.update({"workload": wl, "nemesis": nem,
+                             "seed": args.seed + i})
+                try:
+                    out = run_one(opts)
+                    expected_pass = wl in WORKLOADS_EXPECTED_TO_PASS
+                    if out["valid?"] is not True and expected_pass:
+                        failures.append((wl, nem, out["valid?"]))
+                except NotImplementedError as e:
+                    print(f"SKIP {wl} {nem}: {e}")
+    print(json.dumps({"failures": [repr(f) for f in failures]}))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

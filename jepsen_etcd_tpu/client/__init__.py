@@ -1,0 +1,21 @@
+from . import txn
+from .errors import with_errors, client_error
+from .base import Client, TIMEOUT
+from .direct import DirectClient
+from .etcdctl import EtcdctlClient
+
+__all__ = ["txn", "with_errors", "client_error", "Client", "TIMEOUT",
+           "DirectClient", "EtcdctlClient"]
+
+
+def client(test, node: str):
+    """Construct a client for a node, dispatching on test['client_type']
+    (mirrors the reference constructor dispatch, client.clj:210-222)."""
+    ctype = (test.get("client_type") or "direct") if isinstance(test, dict) \
+        else "direct"
+    cluster = test["cluster"]
+    if ctype == "direct":
+        return DirectClient(cluster, node)
+    if ctype == "etcdctl":
+        return EtcdctlClient(cluster, node)
+    raise ValueError(f"unknown client type {ctype!r}")

@@ -1,0 +1,98 @@
+"""Test composition: the etcd-test analog (etcd.clj:90-155).
+
+Builds a full test map from CLI-style opts: workload + db + nemesis
+package + the phased generator (main phase at :rate under the nemesis,
+then heal, recover, final client generator) + the composed checker stack.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .core.op import NEMESIS
+from .generators import (phases, stagger, time_limit, nemesis as gen_nemesis,
+                         clients as gen_clients, log as gen_log, sleep_gen)
+from .workloads import workloads
+from .checkers import (compose as compose_checkers, Stats,
+                       UnhandledExceptions, LogFilePattern, ClockPlot, Perf)
+from .db import db as make_db
+from .nemesis import nemesis_package
+from .runner.sim import SECOND
+
+DEFAULT_NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def default_opts() -> dict:
+    """CLI defaults mirroring cli-opts (etcd.clj:157-209)."""
+    return {
+        "nodes": list(DEFAULT_NODES),
+        "workload": "register",
+        "nemesis": [],                  # e.g. ["kill", "partition"]
+        "nemesis_interval": 5,          # seconds (etcd.clj:177-180)
+        "rate": 200.0,                  # hz (etcd.clj:190-193)
+        "ops_per_key": 200,             # etcd.clj:182-185
+        "time_limit": 30,               # seconds
+        "concurrency": None,            # default 2n
+        "serializable": False,
+        "lazyfs": False,
+        "client_type": "direct",        # or "etcdctl" (etcd.clj:161-164)
+        "snapshot_count": 100,          # etcd.clj:197-200
+        "seed": 0,
+        "debug": False,
+        "version": "sim-3.5.6",
+    }
+
+
+def etcd_test(opts: dict) -> dict:
+    """Compose opts into a runnable test map (etcd-test, etcd.clj:90-155)."""
+    o = default_opts()
+    o.update(opts or {})
+    n = len(o["nodes"])
+    if not o.get("concurrency"):
+        o["concurrency"] = 2 * n
+    wl_fn = workloads()[o["workload"]]
+    workload = wl_fn(o)
+    o["db"] = make_db(o)
+    nem = nemesis_package(o)
+
+    rate_gap = int(SECOND / o["rate"]) if o["rate"] else 0
+    main_gen = workload["generator"]
+    if rate_gap:
+        main_gen = stagger(rate_gap, main_gen)
+    main_phase = time_limit(
+        int(o["time_limit"] * SECOND),
+        gen_nemesis(
+            phases(sleep_gen(5 * SECOND), nem.get("generator")),
+            main_gen))
+
+    phase_list: list = [main_phase, gen_log("Healing cluster")]
+    if nem.get("final_generator") is not None:
+        phase_list.append(gen_nemesis(nem["final_generator"]))
+    phase_list.append(gen_log("Waiting for recovery"))
+    phase_list.append(sleep_gen(10 * SECOND))
+    if workload.get("final_generator") is not None:
+        phase_list.append(gen_clients(workload["final_generator"]))
+
+    checker = compose_checkers({
+        "perf": Perf(nemesis_perf=nem.get("perf", [])),
+        "clock": ClockPlot(),
+        "stats": Stats(),
+        "exceptions": UnhandledExceptions(),
+        "crash": LogFilePattern(),
+        "workload": workload["checker"],
+    })
+
+    name = "etcd " + " ".join(
+        [o["workload"]] +
+        (["sz"] if o["serializable"] else []) +
+        (sorted(o["nemesis"]) if o["nemesis"] else []))
+    test = dict(o)
+    test.update({
+        "name": name.replace(" ", "-"),
+        "client": workload["client"],
+        "generator": phases(*[p for p in phase_list if p is not None]),
+        "checker": checker,
+        "nemesis": nem.get("nemesis"),
+        "nemesis_package": nem,
+    })
+    return test

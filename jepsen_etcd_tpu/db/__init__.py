@@ -1,0 +1,3 @@
+from .etcd import Db, db
+
+__all__ = ["Db", "db"]

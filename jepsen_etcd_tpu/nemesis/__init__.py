@@ -1,0 +1,3 @@
+from .packages import nemesis_package, Nemesis
+
+__all__ = ["nemesis_package", "Nemesis"]

@@ -49,6 +49,7 @@ async def interpret(
         [NEMESIS] if nemesis_invoke is not None else [])
     workers = {t: t for t in threads}
     free = set(threads)
+    outstanding = {t: 0 for t in threads}  # dispatched, not yet completed
     inboxes = {t: Queue(loop) for t in threads}
     events: Queue = Queue(loop)  # ("invoke"|"complete", thread, op)
     history: list[Op] = []
@@ -84,7 +85,14 @@ async def interpret(
             except Exception as e:  # a worker crash is an indefinite op
                 logger.exception("worker %r crashed on %r", thread, op)
                 done = op.evolve(type=INFO, error=("worker-crash", repr(e)))
-            events.put(("complete", thread, Op(done)))
+            done = Op(done)
+            # Retire the process *here*, before we could dequeue a queued
+            # next op: an :info process must never invoke again
+            # (jepsen semantics; the coordinator may handle this event
+            # only after we've already picked up the next op).
+            if done.get("type") == INFO and isinstance(thread, int):
+                workers[thread] = workers[thread] + concurrency
+            events.put(("complete", thread, done))
 
     tasks = [loop.spawn(worker(t), name=f"worker-{t}") for t in threads]
 
@@ -92,10 +100,9 @@ async def interpret(
         nonlocal gen
         op = record(op)
         if kind == "complete":
-            if len(inboxes[thread]) == 0:
+            outstanding[thread] -= 1
+            if outstanding[thread] == 0:
                 free.add(thread)
-            if op.get("type") == INFO and isinstance(thread, int):
-                workers[thread] = workers[thread] + concurrency
         if gen is not None:
             gen = gen.update(test, ctx(), op)
 
@@ -143,6 +150,7 @@ async def interpret(
         # drains its inbox sequentially); `free` stays false until the
         # inbox is empty again (see handle()).
         free.discard(thread)
+        outstanding[thread] += 1
         inboxes[thread].put(op)
 
     for t in threads:

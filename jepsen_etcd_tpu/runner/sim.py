@@ -361,11 +361,14 @@ async def gather(*aws: Future) -> list:
         try:
             results.append(await a)
         except Cancelled:
-            if not a.done:
-                raise  # thrown into *us*, not raised by a settled child
-            if first_exc is None:
-                first_exc = a._result  # child's own cancellation
-            results.append(None)
+            if a.done and a._state == Future.ERROR:
+                # the settled child's own cancellation surfaced via result()
+                if first_exc is None:
+                    first_exc = a._result
+                results.append(None)
+            else:
+                raise  # thrown into *us* (even if the child happened to
+                       # succeed in the same instant)
         except BaseException as e:  # noqa: BLE001 - propagate after settling
             if first_exc is None:
                 first_exc = e

@@ -1,0 +1,65 @@
+"""Per-test artifact store (the jepsen.store analog).
+
+Layout: store/<test-name>/<seq-timestamp>/{history.jsonl, results.json,
+test.json, timeline.html, latency-raw.png, rate.png, <node>/etcd.log},
+with store/<test-name>/latest symlinked to the newest run and
+store/latest to the newest run overall.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+from typing import Any
+
+_seq = itertools.count()
+
+
+def make_store_dir(base: str, test_name: str) -> str:
+    os.makedirs(base, exist_ok=True)
+    existing = sorted(os.listdir(os.path.join(base, test_name))) \
+        if os.path.isdir(os.path.join(base, test_name)) else []
+    run_id = f"{len([e for e in existing if not e.startswith('latest')]):05d}"
+    path = os.path.join(base, test_name, run_id)
+    os.makedirs(path, exist_ok=True)
+    for link_base, target in ((os.path.join(base, test_name), run_id),
+                              (base, os.path.join(test_name, run_id))):
+        link = os.path.join(link_base, "latest")
+        try:
+            if os.path.islink(link):
+                os.unlink(link)
+            os.symlink(target, link)
+        except OSError:
+            pass
+    return path
+
+
+def _scrub(x: Any):
+    if isinstance(x, dict):
+        return {str(k): _scrub(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_scrub(v) for v in x]
+    if isinstance(x, (set, frozenset)):
+        return sorted((_scrub(v) for v in x), key=repr)
+    if isinstance(x, (str, int, float, bool)) or x is None:
+        return x
+    return repr(x)
+
+
+def save_run(store_dir: str, test: dict, history, results: dict,
+             node_logs: dict) -> None:
+    with open(os.path.join(store_dir, "history.jsonl"), "w") as f:
+        f.write(history.to_jsonl())
+    with open(os.path.join(store_dir, "results.json"), "w") as f:
+        json.dump(_scrub(results), f, indent=2, default=repr)
+    cfg = {k: v for k, v in test.items()
+           if k not in ("cluster", "db", "client", "checker", "generator",
+                        "nemesis", "final_generator")}
+    with open(os.path.join(store_dir, "test.json"), "w") as f:
+        json.dump(_scrub(cfg), f, indent=2, default=repr)
+    for node, lines in node_logs.items():
+        nd = os.path.join(store_dir, node)
+        os.makedirs(nd, exist_ok=True)
+        with open(os.path.join(nd, "etcd.log"), "w") as f:
+            f.write("\n".join(lines))

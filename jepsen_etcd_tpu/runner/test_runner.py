@@ -1,0 +1,122 @@
+"""The test harness: jepsen.core/run! re-designed for the hermetic runtime.
+
+Sequence (SURVEY §3.1): DB setup on every node -> client open/setup per
+worker -> generator interpretation (concurrent invokes + nemesis) ->
+teardown -> checker.check over the recorded history -> artifacts.
+"""
+
+from __future__ import annotations
+
+import logging
+import time as wall_time
+from typing import Any, Optional
+
+from ..core.op import Op, NEMESIS
+from ..core.history import History
+from ..sut.cluster import Cluster, ClusterConfig
+from .sim import SimLoop, set_current_loop, current_loop
+from .interpreter import interpret
+from .store import make_store_dir, save_run
+
+logger = logging.getLogger("jepsen_etcd_tpu.run")
+
+
+class ClientPool:
+    """Per-thread workload clients with jepsen's lifecycle: a worker whose
+    process crashes (:info) gets a fresh client on its next op."""
+
+    def __init__(self, test: dict):
+        self.test = test
+        self.proto = test["client"]
+        self.by_thread: dict[int, tuple[int, Any]] = {}
+
+    def node_for(self, process: int) -> str:
+        nodes = self.test["nodes"]
+        return nodes[process % len(nodes)]
+
+    async def setup_initial(self, concurrency: int) -> None:
+        for t in range(concurrency):
+            c = self.proto.open(self.test, self.node_for(t))
+            self.by_thread[t] = (t, c)
+        # client setup! runs once per initial client before ops start
+        for t in range(concurrency):
+            await self.by_thread[t][1].setup(self.test)
+
+    def client_for(self, process: int) -> Any:
+        t = process % self.test["concurrency"]
+        got = self.by_thread.get(t)
+        if got is not None and got[0] == process:
+            return got[1]
+        if got is not None:
+            got[1].close(self.test)
+        c = self.proto.open(self.test, self.node_for(process))
+        self.by_thread[t] = (process, c)
+        return c
+
+    async def teardown(self) -> None:
+        for t, (p, c) in list(self.by_thread.items()):
+            try:
+                await c.teardown(self.test)
+            finally:
+                c.close(self.test)
+
+
+def run_test(test: dict) -> dict:
+    """Run a composed test map; returns {valid?, results, history, dir}."""
+    seed = test.get("seed", 0)
+    loop = SimLoop(seed=seed)
+    set_current_loop(loop)
+    t0 = wall_time.time()
+    try:
+        cluster = Cluster(loop, list(test["nodes"]),
+                          test.get("cluster_config") or ClusterConfig(
+                              lazyfs=bool(test.get("lazyfs"))))
+        test["cluster"] = cluster
+        db = test["db"]
+        pool = ClientPool(test)
+        nemesis_obj = test.get("nemesis")
+
+        async def invoke(process: int, op: Op) -> Op:
+            client = pool.client_for(process)
+            return await client.invoke(test, op)
+
+        nemesis_invoke = None
+        if nemesis_obj is not None:
+            async def nemesis_invoke(op: Op) -> Op:
+                return await nemesis_obj.invoke(test, op)
+
+        async def main() -> History:
+            logger.info("Setting up DB on %s", test["nodes"])
+            await db.setup(test)
+            if nemesis_obj is not None:
+                await nemesis_obj.setup(test)
+            await pool.setup_initial(test["concurrency"])
+            logger.info("Running generator")
+            h = await interpret(test, test["generator"], invoke,
+                                test["concurrency"],
+                                nemesis_invoke=nemesis_invoke)
+            await pool.teardown()
+            if nemesis_obj is not None:
+                await nemesis_obj.teardown(test)
+            await db.teardown(test)
+            return h
+
+        history = loop.run_coro(main())
+        sim_seconds = loop.now / 1e9
+    finally:
+        set_current_loop(None)
+
+    store_dir = make_store_dir(test.get("store_base", "store"),
+                               test.get("name", "test"))
+    logger.info("Analyzing %d ops (history in %s)", len(history), store_dir)
+    results = test["checker"].check(test, history,
+                                    {"store_dir": store_dir})
+    node_logs = {name: list(node.etcd_log)
+                 for name, node in cluster.nodes.items()}
+    save_run(store_dir, test, history, results, node_logs)
+    wall = wall_time.time() - t0
+    logger.info("Run complete: valid?=%s (%d ops, %.1f sim-s, %.2f wall-s)",
+                results.get("valid?"), len(history), sim_seconds, wall)
+    return {"valid?": results.get("valid?"), "results": results,
+            "history": history, "dir": store_dir,
+            "sim-seconds": sim_seconds, "wall-seconds": wall}

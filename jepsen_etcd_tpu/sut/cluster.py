@@ -553,7 +553,11 @@ class Cluster:
             peer.log = []
             peer.log_start = idx + 1
             peer.commit_index = idx
-        peer.snap_current = leader.snap_current
+        # re-encode from the received state — snapshot transfer is
+        # CRC-verified in etcd, so damaged leader bytes must not propagate
+        peer.snap_current = walmod.encode_records([
+            (peer.snap_index, peer.snap_term, peer.store.clone(),
+             list(peer.membership), dict(peer.leases))])
         peer.wal_current = b""
         peer.fsync()
         peer.applied_since_snap = 0
@@ -679,14 +683,38 @@ class Cluster:
         return out
 
     async def _read_index(self, leader: Node) -> None:
-        """Quorum round before serving a linearizable read."""
-        await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
-        while not (leader.role == "leader" and self.visible_majority(leader)):
+        """Quorum round before serving a linearizable read.
+
+        This is a real heartbeat exchange, not just a reachability count:
+        each contacted peer reports its term, so a stale leader (e.g. one
+        just resumed from SIGSTOP while a successor was elected) is deposed
+        here instead of serving a stale read as linearizable.
+        """
+        while True:
+            await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
             if not leader.alive:
                 raise SimError("unavailable", leader.name)
-            await sleep(self.cfg.heartbeat_interval)
             if leader.role != "leader":
                 raise SimError("leader-changed", leader.name)
+            acks = 0
+            for m in leader.membership:
+                if m == leader.name:
+                    acks += 1
+                    continue
+                peer = self.nodes.get(m)
+                if peer is None or not self.reachable(leader.name, m):
+                    continue
+                if peer.term > leader.term:
+                    leader.term = peer.term
+                    leader.role = "follower"
+                    leader.voted_for = None
+                    self._fail_waiters(leader, SimError(
+                        "leader-changed", "higher term seen on read-index"))
+                    raise SimError("leader-changed", leader.name)
+                acks += 1
+            if acks >= leader.majority():
+                return
+            await sleep(self.cfg.heartbeat_interval)
 
     async def range_read(self, node_name: str, prefix: str,
                          serializable: bool = False) -> list[dict]:

@@ -237,14 +237,17 @@ class Store:
     # -- snapshot / state hash ----------------------------------------------
 
     def state_fingerprint(self) -> int:
-        """Order-independent hash of current kv state, for corruption checks
-        (the analog of etcd's --experimental-corrupt-check-time)."""
-        acc = hash(("rev", self.revision))
+        """Deterministic hash of current kv state, for corruption checks
+        (the analog of etcd's --experimental-corrupt-check-time).
+        Uses crc32 over a canonical encoding — Python's salted hash()
+        would break cross-run reproducibility."""
+        import zlib
+        parts = [f"rev={self.revision}"]
         for k in sorted(self.kvs):
             ks = self.kvs[k]
-            acc ^= hash((k, repr(ks.value), ks.version, ks.create_revision,
-                         ks.mod_revision, ks.lease))
-        return acc
+            parts.append(f"{k}={ks.value!r}:{ks.version}:"
+                         f"{ks.create_revision}:{ks.mod_revision}:{ks.lease}")
+        return zlib.crc32("\n".join(parts).encode())
 
     def clone(self) -> "Store":
         new = Store.__new__(Store)

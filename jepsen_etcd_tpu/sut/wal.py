@@ -12,6 +12,7 @@ its log (cf. the log-file-pattern crash checker, etcd.clj:134-140).
 
 from __future__ import annotations
 
+import math
 import pickle
 import struct
 import zlib
@@ -69,25 +70,20 @@ def decode_records(buf: bytes) -> tuple[list[Any], Optional[str]]:
 def bitflip(buf: bytes, rng, probability: float) -> bytes:
     """Flip each bit independently with the given probability
     (nemesis.clj:183 uses probabilities 1e-3..1e-5)."""
-    if not buf:
+    if not buf or probability <= 0:
         return buf
+    probability = min(probability, 0.999999)
     out = bytearray(buf)
-    # Expected flips = len*8*p; sample flip positions directly.
     nbits = len(out) * 8
-    import math
-    k = 0
     # Binomial sample via repeated geometric skips (cheap, deterministic).
     pos = -1
     while True:
-        if probability <= 0:
-            break
         r = rng.random()
         skip = int(math.log(max(r, 1e-12)) / math.log(1 - probability)) + 1
         pos += skip
         if pos >= nbits:
             break
         out[pos // 8] ^= 1 << (pos % 8)
-        k += 1
     return bytes(out)
 
 

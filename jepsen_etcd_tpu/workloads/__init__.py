@@ -1,0 +1,24 @@
+"""Workload registry (the analog of the workloads map, etcd.clj:33-45)."""
+
+from __future__ import annotations
+
+
+def workloads() -> dict:
+    from . import register, set as set_wl, append, wr, watch, lock, none
+    return {
+        "append": append.workload,
+        "lock": lock.workload,
+        "lock-set": lock.set_workload,
+        "lock-etcd-set": lock.etcd_set_workload,
+        "none": none.workload,
+        "register": register.workload,
+        "set": set_wl.workload,
+        "watch": watch.workload,
+        "wr": wr.workload,
+    }
+
+
+#: workloads expected to pass (etcd.clj:47-53): everything but the lock
+#: family, which demonstrates that etcd locks are unsafe.
+WORKLOADS_EXPECTED_TO_PASS = [
+    "append", "none", "register", "set", "watch", "wr"]

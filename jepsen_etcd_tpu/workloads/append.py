@@ -1,0 +1,5 @@
+"""Placeholder: the append workload lands with the full workload suite."""
+
+
+def workload(opts):
+    raise NotImplementedError("append workload not yet implemented")

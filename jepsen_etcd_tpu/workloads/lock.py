@@ -1,0 +1,11 @@
+"""Placeholder: the lock workload lands with the full workload suite."""
+
+
+def workload(opts):
+    raise NotImplementedError("lock workload not yet implemented")
+def set_workload(opts):
+    raise NotImplementedError("lock-set workload not yet implemented")
+
+
+def etcd_set_workload(opts):
+    raise NotImplementedError("lock-etcd-set workload not yet implemented")

@@ -1,0 +1,5 @@
+"""Placeholder: the set workload lands with the full workload suite."""
+
+
+def workload(opts):
+    raise NotImplementedError("set workload not yet implemented")

@@ -1,0 +1,5 @@
+"""Placeholder: the watch workload lands with the full workload suite."""
+
+
+def workload(opts):
+    raise NotImplementedError("watch workload not yet implemented")

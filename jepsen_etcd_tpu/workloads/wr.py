@@ -1,0 +1,5 @@
+"""Placeholder: the wr workload lands with the full workload suite."""
+
+
+def workload(opts):
+    raise NotImplementedError("wr workload not yet implemented")

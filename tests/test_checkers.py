@@ -1,0 +1,95 @@
+"""Checker unit tests: the CPU linearizability oracle on known-good and
+known-bad histories (SURVEY §4: golden histories regression-test checkers)."""
+
+from jepsen_etcd_tpu.core.op import Op
+from jepsen_etcd_tpu.core.history import History
+from jepsen_etcd_tpu.checkers import check_history
+from jepsen_etcd_tpu.models import VersionedRegister, CASRegister, Mutex
+
+
+def H(*ops):
+    return History([Op(o) for o in ops])
+
+
+def inv(p, f, v):
+    return {"type": "invoke", "process": p, "f": f, "value": v}
+
+
+def ok(p, f, v):
+    return {"type": "ok", "process": p, "f": f, "value": v}
+
+
+def info(p, f, v):
+    return {"type": "info", "process": p, "f": f, "value": v}
+
+
+def fail(p, f, v):
+    return {"type": "fail", "process": p, "f": f, "value": v}
+
+
+def test_trivial_valid():
+    h = H(inv(0, "write", [None, 3]), ok(0, "write", [1, 3]),
+          inv(0, "read", [None, None]), ok(0, "read", [1, 3]))
+    assert check_history(VersionedRegister(), h)["valid?"] is True
+
+
+def test_stale_read_invalid():
+    h = H(inv(0, "write", [None, 3]), ok(0, "write", [1, 3]),
+          inv(0, "write", [None, 4]), ok(0, "write", [2, 4]),
+          inv(0, "read", [None, None]), ok(0, "read", [1, 3]))
+    r = check_history(VersionedRegister(), h)
+    assert r["valid?"] is False
+
+
+def test_concurrent_reads_both_orders_valid():
+    # two concurrent writes; a read may see either
+    h = H(inv(0, "write", [None, 1]), inv(1, "write", [None, 2]),
+          ok(1, "write", [None, 2]), ok(0, "write", [None, 1]),
+          inv(2, "read", [None, None]), ok(2, "read", [2, 1]))
+    assert check_history(VersionedRegister(), h)["valid?"] is True
+
+
+def test_info_op_may_or_may_not_happen():
+    # an indefinite write that a later read observes -> must have happened
+    h = H(inv(0, "write", [None, 9]), info(0, "write", [None, 9]),
+          inv(1, "read", [None, None]), ok(1, "read", [1, 9]))
+    assert check_history(VersionedRegister(), h)["valid?"] is True
+    # ...or is never observed -> also fine
+    h2 = H(inv(0, "write", [None, 9]), info(0, "write", [None, 9]),
+           inv(1, "read", [None, None]), ok(1, "read", [0, None]))
+    assert check_history(VersionedRegister(), h2)["valid?"] is True
+
+
+def test_failed_op_must_not_happen():
+    h = H(inv(0, "write", [None, 9]), fail(0, "write", [None, 9]),
+          inv(1, "read", [None, None]), ok(1, "read", [1, 9]))
+    assert check_history(VersionedRegister(), h)["valid?"] is False
+
+
+def test_cas_semantics():
+    h = H(inv(0, "write", [None, 1]), ok(0, "write", [1, 1]),
+          inv(0, "cas", [None, [1, 5]]), ok(0, "cas", [2, [1, 5]]),
+          inv(0, "read", [None, None]), ok(0, "read", [2, 5]))
+    assert check_history(VersionedRegister(), h)["valid?"] is True
+    h2 = H(inv(0, "write", [None, 1]), ok(0, "write", [1, 1]),
+           inv(0, "cas", [None, [2, 5]]), ok(0, "cas", [2, [2, 5]]))
+    assert check_history(VersionedRegister(), h2)["valid?"] is False
+
+
+def test_mutex_model():
+    h = H(inv(0, "acquire", None), ok(0, "acquire", None),
+          inv(1, "acquire", None), ok(1, "acquire", None))
+    assert check_history(Mutex(), h)["valid?"] is False
+    h2 = H(inv(0, "acquire", None), ok(0, "acquire", None),
+           inv(0, "release", None), ok(0, "release", None),
+           inv(1, "acquire", None), ok(1, "acquire", None))
+    assert check_history(Mutex(), h2)["valid?"] is True
+
+
+def test_cas_register_interleaving():
+    # classic: read must not see a value after it was overwritten,
+    # unless concurrent
+    h = H(inv(0, "write", 1), ok(0, "write", 1),
+          inv(1, "read", None), inv(2, "write", 2),
+          ok(2, "write", 2), ok(1, "read", 2))
+    assert check_history(CASRegister(), h)["valid?"] is True

@@ -1,0 +1,54 @@
+"""End-to-end runs through the composed test harness (the minimum slice:
+register workload against the simulated cluster, SURVEY §7 step 5)."""
+
+import pytest
+
+from jepsen_etcd_tpu.compose import etcd_test
+from jepsen_etcd_tpu.runner.test_runner import run_test
+
+
+def run(tmp_path, **opts):
+    base = {"time_limit": 6, "rate": 50, "ops_per_key": 30,
+            "store_base": str(tmp_path), "seed": 7}
+    base.update(opts)
+    return run_test(etcd_test(base))
+
+
+def test_register_linearizable_passes(tmp_path):
+    out = run(tmp_path, workload="register")
+    assert out["valid?"] is True
+    assert len(out["history"]) > 100
+    wl = out["results"]["workload"]
+    assert wl["key-count"] >= 1
+
+
+def test_register_serializable_fails(tmp_path):
+    # Stale node-local reads are NOT linearizable; the checker must catch it.
+    out = run(tmp_path, workload="register", serializable=True, rate=100,
+              time_limit=8)
+    assert out["valid?"] is False
+
+
+def test_register_etcdctl_backend(tmp_path):
+    out = run(tmp_path, workload="register", client_type="etcdctl")
+    assert out["valid?"] is True
+
+
+def test_none_workload(tmp_path):
+    out = run(tmp_path, workload="none", time_limit=3)
+    assert out["valid?"] is True
+
+
+def test_run_determinism(tmp_path):
+    h1 = run(tmp_path, workload="register", seed=42)["history"].to_jsonl()
+    h2 = run(tmp_path, workload="register", seed=42)["history"].to_jsonl()
+    assert h1 == h2
+
+
+def test_artifacts_written(tmp_path):
+    out = run(tmp_path, workload="register")
+    d = out["dir"]
+    import os
+    for f in ("history.jsonl", "results.json", "test.json", "timeline.html",
+              "latency-raw.png", "rate.png", "n1/etcd.log"):
+        assert os.path.exists(os.path.join(d, f)), f

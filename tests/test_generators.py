@@ -204,3 +204,24 @@ def test_reserve_exact_thread_count_terminates():
     # omitted; only "a" ops run
     assert len([op for op in h.invokes() if op.f == "a"]) == 4
     assert len([op for op in h.invokes() if op.f == "b"]) == 0
+
+
+def test_queued_op_after_info_gets_fresh_process():
+    # Regression: an op queued behind an op that completes :info must be
+    # invoked by the *retired* process's successor, not the old process.
+    calls = [0]
+
+    async def crashy(process, op):
+        calls[0] += 1
+        await sleep(int(0.05 * SECOND))
+        if calls[0] == 1:
+            return op.evolve(type="info", error="timeout")
+        return op.evolve(type="ok")
+
+    h = run_gen(limit(3, repeat({"f": "w", "process": 0})), concurrency=2,
+                invoke=crashy)
+    invs = [op for op in h.invokes()]
+    assert invs[0].process == 0
+    assert all(op.process > 0 and op.process % 2 == 0 for op in invs[1:])
+    # history stays well-formed (every invoke pairs)
+    assert all(h.completion(op) is not None for op in invs)
