@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""The headline benchmark (BASELINE.md north star).
+
+Generates a 10k-op single-key register history with the hermetic
+simulator (seeded, concurrency 8), then times the TPU linearizability
+kernel verifying it. Baseline: the reference's CPU Knossos checker cannot
+verify a 10k-op single-key history within 60 s (it times out; BASELINE.md
+"North star"), so vs_baseline = 60s / our wall-clock.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+N_OPS = 13_500  # ~10k :ok ops after failed-CAS exclusion
+CONCURRENCY = 8
+BASELINE_SECONDS = 60.0  # CPU Knossos budget it cannot meet
+
+
+def generate_history(n_ops: int = N_OPS, seed: int = 2026):
+    """10k ops on ONE key via the simulated cluster (fast: virtual time)."""
+    from jepsen_etcd_tpu.compose import etcd_test
+    from jepsen_etcd_tpu.runner.test_runner import run_test
+    from jepsen_etcd_tpu.generators import limit, mix, reserve, independent
+    from jepsen_etcd_tpu.workloads.register import (RegisterClient, r, w,
+                                                    cas)
+    from jepsen_etcd_tpu.checkers.core import Noop
+
+    test = etcd_test({
+        "workload": "none",
+        "time_limit": 3600, "rate": 0, "seed": seed,
+        "concurrency": CONCURRENCY, "store_base": "store",
+    })
+    test["name"] = "bench-register-10k"
+    test["client"] = RegisterClient()
+    test["checker"] = Noop()
+    test["generator"] = independent.concurrent_generator(
+        CONCURRENCY, [0],
+        lambda k: limit(n_ops, reserve(CONCURRENCY // 2, r, mix([w, cas]))))
+    out = run_test(test)
+    from jepsen_etcd_tpu.generators.independent import subhistory
+    from jepsen_etcd_tpu.core.history import History
+    return History(subhistory(out["history"], 0))
+
+
+def main() -> int:
+    t0 = time.time()
+    h = generate_history()
+    gen_s = time.time() - t0
+    n_ok = len([o for o in h if o.is_ok])
+    print(f"# generated {len(h)} ops ({n_ok} ok) in {gen_s:.1f}s",
+          file=sys.stderr)
+
+    from jepsen_etcd_tpu.ops import wgl
+    p = wgl.pack_register_history(h)
+    if not p.ok:
+        print(f"# pack failed: {p.reason}", file=sys.stderr)
+        return 1
+    print(f"# packed R={p.R}", file=sys.stderr)
+
+    # warmup/compile on a small slice so the timed run measures the search
+    wgl.check_packed(p)  # first call compiles + runs
+    t1 = time.time()
+    out = wgl.check_packed(p)
+    check_s = time.time() - t1
+    print(f"# kernel verdict={out['valid?']} waves={out.get('waves')} "
+          f"peak-frontier={out.get('peak-frontier')} in {check_s:.3f}s "
+          f"(first call incl. compile: {t1 - t0 - gen_s:.1f}s)",
+          file=sys.stderr)
+    if out["valid?"] is not True:
+        print(f"# UNEXPECTED verdict: {out}", file=sys.stderr)
+        return 1
+
+    print(json.dumps({
+        "metric": "register_linearizability_10k_ops_check_wallclock",
+        "value": round(check_s, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_SECONDS / max(check_s, 1e-9), 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

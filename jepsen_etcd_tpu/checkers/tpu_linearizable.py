@@ -1,0 +1,58 @@
+"""checker/tpu-linearizable: the TPU fast path with a sound fallback.
+
+Binding analog of ``checker/linearizable`` (register.clj:110-112) but
+running the search on-device (ops/wgl.py). Soundness contract: the kernel
+answers definitively only when its preconditions hold (window fits, no
+info ops, no frontier overflow); anything else falls back to the CPU
+oracle — the TPU path can be fast, it must never be wrong.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..models import VersionedRegister
+from .core import Checker
+from .linearizable import check_history
+
+logger = logging.getLogger("jepsen_etcd_tpu.checkers")
+
+
+class TPULinearizableChecker(Checker):
+    def __init__(self, model_fn=None, fallback: bool = True,
+                 f_max: Optional[int] = None):
+        self.model_fn = model_fn or (lambda: VersionedRegister(0, None))
+        self.fallback = fallback
+        self.f_max = f_max
+
+    def check(self, test, history, opts=None) -> dict:
+        from ..ops import wgl
+        # The kernel implements exactly VersionedRegister(0, None); any
+        # other model/initial state must take the CPU path.
+        if self.model_fn() != VersionedRegister(0, None):
+            reason = "model is not VersionedRegister(0, None)"
+            p = None
+        else:
+            p = wgl.pack_register_history(history)
+            reason = None
+        if p is not None and p.ok:
+            out = wgl.check_packed(p, f_max=self.f_max)
+            if out["valid?"] != "unknown":
+                out["checker"] = "tpu-wgl"
+                return out
+            reason = out.get("reason", "unknown")
+        elif p is not None:
+            reason = p.reason
+        if not self.fallback:
+            return {"valid?": "unknown", "reason": reason,
+                    "checker": "tpu-wgl"}
+        logger.debug("TPU path unavailable (%s); CPU oracle", reason)
+        out = check_history(self.model_fn(), history)
+        out["checker"] = "cpu-oracle"
+        out["tpu-fallback-reason"] = reason
+        return out
+
+
+def tpu_linearizable(model_fn=None) -> TPULinearizableChecker:
+    return TPULinearizableChecker(model_fn)

@@ -1,0 +1,6 @@
+"""The TPU kernels: history analysis as JAX programs.
+
+- wgl: linearizability search as windowed-bitmask frontier BFS
+- closure: boolean-matmul transitive closure / SCC for Elle
+- edit_distance: anti-diagonal wavefront DP for the watch checker
+"""

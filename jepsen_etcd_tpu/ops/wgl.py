@@ -1,0 +1,349 @@
+"""Linearizability search as a TPU frontier BFS (the north-star kernel).
+
+Replaces Knossos' CPU Wing-Gong/Lowe search (reference binding at
+``register.clj:110-112``) for versioned-register histories. The key
+insight making the search TPU-shaped: in a history with bounded
+concurrency, sort the must-linearize (:ok) ops by invocation; then any
+reachable "linearized set" consists of a *forced prefix* plus a bitmask
+over a sliding window of at most W undecided ops. A search state packs to
+
+    (depth d, uint32 window mask, model value id)
+
+and a BFS wave over depth d is a dense [F, W] tensor expansion:
+- enabled = window bit clear ∧ precomputed predecessor-mask bits set,
+- model step = table-driven versioned-register transition
+  (version is *derived*: forced-prefix update count + popcount of update
+  bits in the window — no per-state version storage),
+- window slide = shift by (lo[d+1]-lo[d]) with shifted-out-bits-must-be-
+  set pruning,
+- dedup = 2-key lax.sort + neighbor-compare + scatter compaction.
+
+The wave loop is a lax.while_loop; all shapes are static (F_MAX x W), so
+one compile serves all histories of a bucketed length. Overflow (frontier
+beyond F_MAX) or window overflow (> W concurrent undecided ops) returns
+UNKNOWN and the caller falls back to the CPU oracle
+(checkers/linearizable.py) — the TPU fast path never *wrongly* answers.
+
+Histories containing :info (indefinite) ops currently take the CPU path:
+an info op may linearize at any point or never, which breaks the
+forced-prefix invariant. (Planned: separate persistent info-bit words.)
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from ..checkers.linearizable import Entry, history_entries
+
+W = 32          # window width (max undecided concurrent required ops)
+F_MAX = 512     # frontier capacity per wave
+SENTINEL_W = np.uint32(0xFFFFFFFF)
+SENTINEL_V = np.int32(2 ** 31 - 1)
+
+READ, WRITE, CAS = 0, 1, 2
+NO_ASSERT = -(2 ** 30)  # distinct from any real (possibly corrupted) version
+NONE_VAL = 0     # value id for "key unset"
+WILDCARD = -1    # read asserted nothing
+
+
+@dataclass
+class Packed:
+    """Host-packed tables for one key's history."""
+
+    ok: bool
+    reason: str = ""
+    R: int = 0
+    n_values: int = 0
+    # all [R, W] unless noted
+    shift: Any = None         # [R] int32
+    static_ok: Any = None     # [R, W] bool
+    f_code: Any = None        # [R, W] int8
+    a1: Any = None            # [R, W] int32 (read: rval / write: wval / cas: old)
+    a2: Any = None            # [R, W] int32 (cas: new)
+    ver: Any = None           # [R, W] int32 (version assertion or -1)
+    pred_frame: Any = None    # [R, W] uint32
+    upd_mask: Any = None      # [R] uint32
+    u_forced: Any = None      # [R] int32
+
+
+def pack_register_history(history, value_ids: Optional[dict] = None,
+                          w: int = W) -> Packed:
+    """Build the per-depth tables for the kernel. Returns ok=False with a
+    reason when the history needs the CPU path."""
+    entries = history_entries(history)
+    infos = [e for e in entries if not e.required]
+    if infos:
+        return Packed(ok=False, reason=f"{len(infos)} info ops (CPU path)")
+    req = sorted([e for e in entries if e.required], key=lambda e: e.invoke)
+    R = len(req)
+    if R == 0:
+        return Packed(ok=True, R=0)
+
+    # value id mapping: 0 = None (unset); concrete values from 1
+    vid = dict(value_ids or {})
+
+    def val_id(v):
+        if v is None:
+            return NONE_VAL
+        key = repr(v)
+        if key not in vid:
+            vid[key] = len(vid) + 1
+        return vid[key]
+
+    inv = np.array([e.invoke for e in req], dtype=np.int64)
+    ret = np.array([e.ret for e in req], dtype=np.int64)
+    f = np.zeros(R, dtype=np.int8)
+    a1 = np.zeros(R, dtype=np.int32)
+    a2 = np.zeros(R, dtype=np.int32)
+    ver = np.full(R, NO_ASSERT, dtype=np.int32)
+    for i, e in enumerate(req):
+        if e.f == "read":
+            f[i] = READ
+            rv, rval = e.value if e.value is not None else (None, None)
+            ver[i] = NO_ASSERT if rv is None else int(rv)
+            # A None read value asserts nothing (VersionedRegister.step
+            # treats nil op-value as unchecked REGARDLESS of version —
+            # an unset-key read [0, None] is constrained via version 0).
+            a1[i] = WILDCARD if rval is None else val_id(rval)
+        elif e.f == "write":
+            f[i] = WRITE
+            wv, wval = e.value
+            ver[i] = NO_ASSERT if wv is None else int(wv)
+            a1[i] = val_id(wval)
+        elif e.f == "cas":
+            f[i] = CAS
+            cv, (old, new) = e.value
+            ver[i] = NO_ASSERT if cv is None else int(cv)
+            a1[i] = val_id(old)
+            a2[i] = val_id(new)
+        else:
+            return Packed(ok=False, reason=f"op f={e.f!r} not supported")
+
+    sorted_ret = np.sort(ret)
+    pred = np.searchsorted(sorted_ret, inv, side="left")  # ret[j] < inv[i]
+    cap = np.searchsorted(inv, ret, side="left") - 1      # inv[j] < ret[i], j != i
+
+    # lo[d] = first rank that can still be absent from a depth-d prefix
+    lo = np.zeros(R + 1, dtype=np.int64)
+    p = 0
+    for d in range(R + 1):
+        while p < R and cap[p] < d:
+            p += 1
+        lo[d] = p
+    # feasibility: window must hold all set bits and all enabled candidates
+    width_bits = np.max(np.arange(R + 1) - lo) if R else 0
+    first_lo = lo[np.minimum(pred, R)]
+    width_cand = np.max(np.arange(R) - first_lo) + 1 if R else 0
+    if max(width_bits, width_cand) > w:
+        return Packed(ok=False,
+                      reason=f"window {max(width_bits, width_cand)} > {w} "
+                             f"(concurrency too high for kernel)")
+
+    d_idx = np.arange(R)[:, None]                       # [R, 1]
+    b_idx = np.arange(w)[None, :]                       # [1, W]
+    idx = np.minimum(lo[:R][:, None] + b_idx, R - 1)    # [R, W] clamped
+    in_range = (lo[:R][:, None] + b_idx) < R
+    static_ok = in_range & (pred[idx] <= d_idx)
+
+    # predecessor bits within the frame: bit c <-> rank lo[d]+c
+    frame_rank = np.minimum(lo[:R][:, None] + b_idx, R - 1)   # same as idx
+    ret_frame = ret[frame_rank]                               # [R, W]
+    inv_cand = inv[idx]                                       # [R, W]
+    is_pred = (ret_frame[:, None, :] < inv_cand[:, :, None])  # [R, W, W]
+    in_range_c = ((lo[:R][:, None] + b_idx) < R)[:, None, :]  # [R, 1, W]
+    bits = (1 << np.arange(w, dtype=np.uint64))
+    pred_frame = ((is_pred & in_range_c) * bits).sum(-1).astype(np.uint32)
+
+    is_upd = (f == WRITE) | (f == CAS)
+    upd_frame = is_upd[frame_rank] & in_range
+    upd_mask = (upd_frame * bits).sum(-1).astype(np.uint32)
+    cum_upd = np.concatenate([[0], np.cumsum(is_upd)])
+    u_forced = cum_upd[lo[:R]].astype(np.int32)
+
+    return Packed(
+        ok=True, R=R, n_values=len(vid) + 1,
+        shift=(lo[1:] - lo[:-1]).astype(np.int32),
+        static_ok=static_ok,
+        f_code=f[idx].astype(np.int8),
+        a1=a1[idx], a2=a2[idx], ver=ver[idx],
+        pred_frame=pred_frame, upd_mask=upd_mask, u_forced=u_forced,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _kernel_jitted(f_max: int, w: int):
+    import jax
+    return jax.jit(functools.partial(_wgl_kernel, f_max=f_max, w=w))
+
+
+def _wgl_kernel(tables: dict, R, f_max: int = F_MAX, w: int = W):
+    """Run the wave loop. tables hold the [R_pad, W] arrays; R is the
+    dynamic number of waves. Returns (valid, overflow, waves_done,
+    frontier_size_max)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    shift = tables["shift"]
+    static_ok = tables["static_ok"]
+    f_code = tables["f_code"]
+    a1 = tables["a1"]
+    a2 = tables["a2"]
+    ver = tables["ver"]
+    pred_frame = tables["pred_frame"]
+    upd_mask = tables["upd_mask"]
+    u_forced = tables["u_forced"]
+
+    bpos = jnp.arange(w, dtype=jnp.uint32)[None, :]        # [1, W]
+    bit = (jnp.uint32(1) << bpos)
+
+    def body(carry):
+        d, wmask, val, n_alive, overflow, peak = carry
+        # vmap-safety guard: under vmap, while_loop runs until ALL batch
+        # elements finish; finished elements must be no-ops.
+        active = (d < R) & (n_alive > 0) & (~overflow)
+        # row d of each table
+        row = lambda t: lax.dynamic_index_in_dim(t, d, 0, keepdims=False)
+        s_ok = row(static_ok)[None, :]                      # [1, W]
+        fc = row(f_code)[None, :]
+        ra1 = row(a1)[None, :]
+        ra2 = row(a2)[None, :]
+        rver = row(ver)[None, :]
+        rpred = row(pred_frame)[None, :]
+        rupd = row(upd_mask)
+        ruf = row(u_forced)
+        rshift = row(shift).astype(jnp.uint32)
+
+        alive = (jnp.arange(f_max) < n_alive)[:, None]      # [F, 1]
+        wm = wmask[:, None]                                 # [F, 1]
+        not_set = ((wm >> bpos) & 1) == 0
+        preds_in = (wm & rpred) == rpred
+        version = ruf + lax.population_count(wm & rupd).astype(jnp.int32)
+        v = val[:, None]                                    # [F, 1]
+
+        is_read = fc == READ
+        is_write = fc == WRITE
+        is_cas = fc == CAS
+        no_assert = rver == NO_ASSERT
+        ver_ok = jnp.where(is_read,
+                           no_assert | (rver == version),
+                           no_assert | (rver == version + 1))
+        read_ok = is_read & ((ra1 == WILDCARD) | (ra1 == v))
+        cas_ok = is_cas & (ra1 == v)
+        model_ok = read_ok | is_write | cas_ok
+        valid = alive & s_ok & not_set & preds_in & ver_ok & model_ok
+
+        new_w = wm | bit                                    # [F, W]
+        # shift may equal w (whole window forced at once); uint32 << 32
+        # is implementation-defined, so saturate explicitly
+        full_slide = rshift >= jnp.uint32(w)
+        low_mask = jnp.where(full_slide, jnp.uint32(0xFFFFFFFF),
+                             (jnp.uint32(1) << rshift) - jnp.uint32(1))
+        slide_ok = (new_w & low_mask) == low_mask
+        valid = valid & slide_ok
+        new_w = jnp.where(full_slide, jnp.uint32(0), new_w >> rshift)
+        new_v = jnp.where(is_read, v,
+                          jnp.where(is_write, ra1, ra2)).astype(jnp.int32)
+
+        # dedup: sort flattened (w, v) with sentinels for invalid slots
+        flat_w = jnp.where(valid, new_w, jnp.uint32(SENTINEL_W)).reshape(-1)
+        flat_v = jnp.where(valid, new_v, SENTINEL_V).reshape(-1)
+        sw, sv = lax.sort((flat_w, flat_v), num_keys=2)
+        is_real = sw != jnp.uint32(SENTINEL_W)
+        first = jnp.concatenate([
+            jnp.array([True]),
+            (sw[1:] != sw[:-1]) | (sv[1:] != sv[:-1])])
+        uniq = is_real & first
+        pos = jnp.cumsum(uniq.astype(jnp.int32)) - 1
+        n_new = jnp.sum(uniq.astype(jnp.int32))
+        pos = jnp.where(uniq & (pos < f_max), pos, f_max)   # drop overflowed
+        out_w = jnp.full((f_max + 1,), SENTINEL_W, dtype=jnp.uint32)
+        out_v = jnp.full((f_max + 1,), SENTINEL_V, dtype=jnp.int32)
+        out_w = out_w.at[pos].set(sw, mode="drop")
+        out_v = out_v.at[pos].set(sv, mode="drop")
+        out_w = out_w[:f_max]
+        out_v = out_v[:f_max]
+        return (jnp.where(active, d + 1, d),
+                jnp.where(active, out_w, wmask),
+                jnp.where(active, out_v, val),
+                jnp.where(active, jnp.minimum(n_new, f_max), n_alive),
+                jnp.where(active, overflow | (n_new > f_max), overflow),
+                jnp.where(active, jnp.maximum(peak, n_new), peak))
+
+    def cond(carry):
+        d, _, _, n_alive, overflow, _ = carry
+        return (d < R) & (n_alive > 0) & (~overflow)
+
+    w0 = jnp.full((f_max,), SENTINEL_W, dtype=jnp.uint32)
+    w0 = w0.at[0].set(0)
+    v0 = jnp.full((f_max,), SENTINEL_V, dtype=jnp.int32)
+    v0 = v0.at[0].set(NONE_VAL)
+    init = (jnp.int32(0), w0, v0, jnp.int32(1), jnp.bool_(False),
+            jnp.int32(1))
+    d, _, _, n_alive, overflow, peak = lax.while_loop(cond, body, init)
+    valid = (d >= R) & (n_alive > 0) & (~overflow)
+    return valid, overflow, d, peak
+
+
+def bucket(n: int) -> int:
+    """Pad R to a power-of-two bucket so jit caches stay warm."""
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_tables(p: Packed, r_pad: int):
+    """Pad the per-depth tables to a bucketed length (shared by
+    check_packed and the __graft_entry__ paths)."""
+    def padded(a, fill=0):
+        out = np.full((r_pad,) + a.shape[1:], fill, dtype=a.dtype)
+        out[:p.R] = a
+        return out
+
+    return {
+        "shift": padded(p.shift), "static_ok": padded(p.static_ok),
+        "f_code": padded(p.f_code), "a1": padded(p.a1), "a2": padded(p.a2),
+        "ver": padded(p.ver), "pred_frame": padded(p.pred_frame),
+        "upd_mask": padded(p.upd_mask), "u_forced": padded(p.u_forced),
+    }
+
+
+def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
+    """Run the kernel on one packed history (host->device->host).
+
+    f_max defaults small for short histories (tiny sorts, fast waves) —
+    an overflow retries at full capacity before falling back to CPU.
+    """
+    import jax.numpy as jnp
+
+    if not p.ok:
+        return {"valid?": "unknown", "reason": p.reason}
+    if p.R == 0:
+        return {"valid?": True, "waves": 0}
+    if f_max is None:
+        # frontiers are tiny on healthy histories (peak ~tens); start
+        # small — sorts are 4x cheaper — and retry at F_MAX on overflow
+        f_max = 128
+    tables = {k: jnp.asarray(v)
+              for k, v in pad_tables(p, bucket(p.R)).items()}
+    valid, overflow, d, peak = _kernel_jitted(f_max, W)(
+        tables, jnp.int32(p.R))
+    valid = bool(valid)
+    overflow = bool(overflow)
+    if overflow and f_max < F_MAX:
+        return check_packed(p, f_max=F_MAX)  # retry at full capacity
+    if overflow:
+        return {"valid?": "unknown", "reason": "frontier overflow",
+                "peak-frontier": int(peak)}
+    return {"valid?": valid, "waves": int(d), "peak-frontier": int(peak),
+            "ops": p.R,
+            **({} if valid else {"stuck-at-depth": int(d)})}

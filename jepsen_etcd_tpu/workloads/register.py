@@ -15,8 +15,8 @@ from ..core.op import Op
 from ..client import with_errors
 from ..generators import independent, mix, reserve, limit
 from ..models import VersionedRegister
-from ..checkers import (compose, independent_checker, linearizable,
-                        TimelineHtml)
+from ..checkers import compose, independent_checker, TimelineHtml
+from ..checkers.tpu_linearizable import TPULinearizableChecker
 from .base import WorkloadClient
 
 
@@ -74,7 +74,9 @@ def workload(opts: dict) -> dict:
     return {
         "client": RegisterClient(),
         "checker": independent_checker(compose({
-            "linear": linearizable(lambda: VersionedRegister(0, None)),
+            # TPU frontier-BFS kernel with sound CPU-oracle fallback
+            "linear": TPULinearizableChecker(
+                lambda: VersionedRegister(0, None)),
             "timeline": TimelineHtml(),
         })),
         "generator": independent.concurrent_generator(

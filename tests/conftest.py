@@ -2,7 +2,10 @@
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths
 (pjit/shard_map over a Mesh) compile and execute without TPU hardware.
-Must set XLA flags before jax is first imported anywhere.
+
+NOTE: this environment's axon site hook force-registers the tunneled TPU
+backend and overrides JAX_PLATFORMS from the environment, so we must
+override back via jax.config *before* any backend initialization.
 """
 
 import os
@@ -16,3 +19,8 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,206 @@
+"""Differential tests: TPU WGL kernel vs the CPU oracle.
+
+The kernel's verdict must match the oracle on every history where it
+claims a definitive answer (SURVEY §7 step 6: validate on thousands of
+small random histories; known-bad fixtures must stay invalid).
+"""
+
+import random
+
+import pytest
+
+from jepsen_etcd_tpu.core.op import Op
+from jepsen_etcd_tpu.core.history import History
+from jepsen_etcd_tpu.checkers import check_history
+from jepsen_etcd_tpu.checkers.tpu_linearizable import TPULinearizableChecker
+from jepsen_etcd_tpu.models import VersionedRegister
+from jepsen_etcd_tpu.ops import wgl
+
+
+def gen_history(rng: random.Random, n_procs=4, n_ops=20, values=3,
+                corrupt=False):
+    """Random concurrent register history via linearization-point
+    simulation: ops apply atomically at a random instant inside their
+    [invoke, complete] span, so the generated history is linearizable by
+    construction — unless `corrupt` flips some observations."""
+    events = []  # (time, kind, proc, ...)
+    t = 0.0
+    state_v = 0   # version
+    state_val = None
+    # build per-process schedules: (start, end) spans
+    spans = []
+    for p in range(n_procs):
+        at = rng.random()
+        for _ in range(n_ops // n_procs):
+            dur = 0.1 + rng.random()
+            spans.append((at, at + dur, p))
+            at += dur + rng.random() * 0.3
+    # linearization points decide outcomes
+    pts = sorted((rng.uniform(s, e), i) for i, (s, e, p) in enumerate(spans))
+    outcomes = {}
+    for _, i in pts:
+        s, e, p = spans[i]
+        f = rng.choice(["read", "write", "cas"])
+        if f == "read":
+            outcomes[i] = ("read", [state_v, state_val])
+        elif f == "write":
+            v = rng.randrange(values)
+            state_v += 1
+            state_val = v
+            outcomes[i] = ("write", [state_v, v])
+        else:
+            old = rng.randrange(values)
+            new = rng.randrange(values)
+            if state_val == old:
+                state_v += 1
+                state_val = new
+                outcomes[i] = ("cas", [state_v, [old, new]])
+            else:
+                outcomes[i] = ("cas-fail", [None, [old, new]])
+    ops = []
+    evs = []
+    for i, (s, e, p) in enumerate(spans):
+        evs.append((s, "inv", i, p))
+        evs.append((e, "ret", i, p))
+    evs.sort()
+    for _, kind, i, p in evs:
+        f, val = outcomes[i]
+        if kind == "inv":
+            fv = f if f != "cas-fail" else "cas"
+            ops.append(Op(type="invoke", process=p, f=fv,
+                          value=[None, val[1]] if fv != "read"
+                          else [None, None]))
+        else:
+            if f == "cas-fail":
+                ops.append(Op(type="fail", process=p, f="cas",
+                              value=[None, val[1]], error="did-not-succeed"))
+            else:
+                v = list(val)
+                if corrupt and rng.random() < 0.15:
+                    if rng.random() < 0.5 and v[0] is not None:
+                        v[0] = v[0] + rng.choice([-1, 1])
+                    else:
+                        v[1] = (v[1] + 1) % values if isinstance(v[1], int) \
+                            else v[1]
+                ops.append(Op(type="ok", process=p, f=f, value=v))
+    return History(ops)
+
+
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_differential_random_histories(corrupt):
+    rng = random.Random(1234 if corrupt else 99)
+    checker = TPULinearizableChecker(fallback=False)
+    agree = 0
+    definitive = 0
+    for trial in range(150):
+        h = gen_history(rng, n_procs=rng.randint(2, 5),
+                        n_ops=rng.randint(8, 32), corrupt=corrupt)
+        cpu = check_history(VersionedRegister(), h)
+        tpu = checker.check({}, h)
+        if tpu["valid?"] == "unknown":
+            continue
+        definitive += 1
+        assert tpu["valid?"] == cpu["valid?"], (
+            f"trial {trial}: kernel={tpu} oracle={cpu['valid?']}\n"
+            + h.to_jsonl())
+        agree += 1
+    # the kernel must actually cover the vast majority of histories
+    assert definitive >= 130, f"only {definitive}/150 definitive"
+
+
+def test_clean_histories_all_valid():
+    # uncorrupted histories are linearizable by construction
+    rng = random.Random(7)
+    checker = TPULinearizableChecker(fallback=False)
+    for _ in range(50):
+        h = gen_history(rng, n_procs=3, n_ops=18, corrupt=False)
+        out = checker.check({}, h)
+        if out["valid?"] != "unknown":
+            assert out["valid?"] is True, h.to_jsonl()
+
+
+def test_kernel_packing_feasibility():
+    rng = random.Random(5)
+    h = gen_history(rng, n_procs=4, n_ops=24)
+    p = wgl.pack_register_history(h)
+    assert p.ok
+    assert p.R > 0
+    # every op is forced by depth R: total slide equals R
+    assert p.shift.sum() == p.R
+
+
+def test_info_ops_fall_back():
+    h = History([
+        Op(type="invoke", process=0, f="write", value=[None, 1]),
+        Op(type="info", process=0, f="write", value=[None, 1]),
+    ])
+    p = wgl.pack_register_history(h)
+    assert not p.ok and "info" in p.reason
+    out = TPULinearizableChecker(fallback=True).check({}, h)
+    assert out["valid?"] is True and out["checker"] == "cpu-oracle"
+
+
+def test_kernel_on_real_run_history(tmp_path):
+    # end-to-end: swap the register workload's checker to the TPU kernel
+    from jepsen_etcd_tpu.compose import etcd_test
+    from jepsen_etcd_tpu.runner.test_runner import run_test
+    from jepsen_etcd_tpu.generators.independent import history_keys, subhistory
+
+    out = run_test(etcd_test({
+        "workload": "register", "time_limit": 6, "rate": 60,
+        "ops_per_key": 40, "store_base": str(tmp_path), "seed": 17}))
+    h = out["history"]
+    checker = TPULinearizableChecker(fallback=False)
+    n_checked = 0
+    for k in history_keys(h):
+        sub = History(subhistory(h, k))
+        r = checker.check({}, sub)
+        cpu = check_history(VersionedRegister(), sub)
+        if r["valid?"] != "unknown":
+            assert r["valid?"] == cpu["valid?"]
+            n_checked += 1
+    assert n_checked >= 1
+
+
+def test_read_none_value_is_wildcard():
+    # Regression: a read [v>0, None] asserts only the version, like the
+    # CPU model (nil op-value is unchecked).
+    h = History([
+        Op(type="invoke", process=0, f="write", value=[None, 3]),
+        Op(type="ok", process=0, f="write", value=[1, 3]),
+        Op(type="invoke", process=0, f="read", value=[None, None]),
+        Op(type="ok", process=0, f="read", value=[1, None]),
+    ])
+    cpu = check_history(VersionedRegister(), h)
+    tpu = TPULinearizableChecker(fallback=False).check({}, h)
+    assert cpu["valid?"] is True
+    assert tpu["valid?"] is True
+
+
+def test_full_window_slide():
+    # 32 mutually-concurrent ops force a whole-window slide (shift == W,
+    # the uint32<<32 hazard) AND a combinatorial frontier: the kernel must
+    # never answer wrongly — overflow -> unknown -> CPU fallback.
+    ops = []
+    for p in range(32):
+        ops.append(Op(type="invoke", process=p, f="write", value=[None, 1]))
+    for p in range(32):
+        ops.append(Op(type="ok", process=p, f="write", value=[None, 1]))
+    h = History(ops)
+    pk = wgl.pack_register_history(h)
+    assert pk.ok and int(pk.shift.max()) == 32
+    raw = TPULinearizableChecker(fallback=False).check({}, h)
+    assert raw["valid?"] in (True, "unknown")  # never a wrong False
+    out = TPULinearizableChecker(fallback=True).check({}, h)
+    assert out["valid?"] is True
+
+
+def test_non_register_model_goes_to_cpu():
+    from jepsen_etcd_tpu.models import Mutex
+    h = History([
+        Op(type="invoke", process=0, f="acquire", value=None),
+        Op(type="ok", process=0, f="acquire", value=None),
+    ])
+    out = TPULinearizableChecker(lambda: Mutex()).check({}, h)
+    assert out["checker"] == "cpu-oracle"
+    assert out["valid?"] is True
