@@ -62,8 +62,9 @@ def main() -> int:
         return 1
     print(f"# packed R={p.R}", file=sys.stderr)
 
-    # warmup/compile on a small slice so the timed run measures the search
-    wgl.check_packed(p)  # first call compiles + runs
+    # warmup: first call compiles and runs the full search; the timed
+    # second call measures steady-state search wall-clock
+    wgl.check_packed(p)
     t1 = time.time()
     out = wgl.check_packed(p)
     check_s = time.time() - t1

@@ -3,11 +3,12 @@ from .core import (Checker, Compose, compose, Stats, UnhandledExceptions,
 from .independent import Independent, independent_checker
 from .linearizable import LinearizableChecker, linearizable, check_history
 from .perf import Perf
+from .set_full import SetFull, set_full
 from .timeline import TimelineHtml
 
 __all__ = [
     "Checker", "Compose", "compose", "Stats", "UnhandledExceptions",
     "LogFilePattern", "ClockPlot", "Noop", "Independent",
     "independent_checker", "LinearizableChecker", "linearizable",
-    "check_history", "Perf", "TimelineHtml",
+    "check_history", "Perf", "SetFull", "set_full", "TimelineHtml",
 ]

@@ -1,0 +1,183 @@
+"""The set-full checker: full lifecycle analysis of set elements.
+
+Re-implements the jepsen library checker the reference binds at
+``set.clj:46`` and ``lock.clj:258`` (``checker/set-full
+{:linearizable? true}``). The history contains ``add`` ops (one element
+each) and ``read`` ops (whole set). For every attempted element we track
+its lifecycle against all reads:
+
+- an element becomes **known** once its add completes :ok, or once any
+  :ok read observes it (whichever is earliest);
+- reads *invoked after* the known point must observe it; a read that
+  misses it is an **absent observation**;
+- outcome per element:
+    * ``stable``     — known, and every read after the known point saw it;
+    * ``lost``       — known, and the last read(s) no longer see it
+                       (absent with no later present observation);
+    * ``stale``      — known, temporarily absent, but visible again later
+                       (legal only for non-linearizable sets);
+    * ``never-read`` — possibly present (add :ok or :info) but no read
+                       after it ever ran / observed it — proves nothing;
+    * ``unknown``    — add :info and never observed (may simply not have
+                       happened).
+
+``valid?`` is false when any element is lost, or (with
+``linearizable=True``) when any stale window exists; it is ``"unknown"``
+when nothing was ever read (no information).
+
+Timing: stale windows are measured in virtual nanoseconds between the
+known time and the first subsequent present read, matching the spirit of
+the reference checker's ``:worst-stale`` report.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..core.history import History
+from .core import Checker
+
+
+@dataclass
+class _Element:
+    value: Any
+    add_invoke: Optional[int] = None      # history index
+    add_type: Optional[str] = None        # ok | fail | info
+    known_index: Optional[int] = None     # index where presence is proven
+    known_time: Optional[int] = None
+    absent: list = field(default_factory=list)   # reads (index) missing it
+    present_after_absent: bool = False
+    last_read_state: Optional[bool] = None       # seen in last covering read
+    stale_until: Optional[int] = None            # time first re-observed
+
+
+def analyze(history) -> dict:
+    h = history if isinstance(history, History) else History(history)
+    elements: dict[Any, _Element] = {}
+    # reads: (invoke_index, invoke_time, ok_index, value-as-set, dup-list)
+    reads: list[tuple[int, int, int, frozenset, list]] = []
+    duplicated: dict[Any, int] = {}
+
+    for op in h:
+        if not op.is_client_op:
+            continue
+        if op.f == "add":
+            x = op.value
+            el = elements.setdefault(x, _Element(value=x))
+            if op.is_invoke:
+                el.add_invoke = op.index
+            else:
+                el.add_type = op["type"]
+        elif op.f == "read" and op.is_ok and op.value is not None:
+            inv = h.invocation(op)
+            vals = list(op.value)
+            vset = frozenset(vals)
+            if len(vals) != len(vset):
+                seen: set = set()
+                for v in vals:
+                    if v in seen:
+                        duplicated[v] = duplicated.get(v, 0) + 1
+                    seen.add(v)
+            reads.append((inv.index if inv is not None else op.index,
+                          (inv or op).time or 0, op.index, vset, vals))
+
+    reads.sort()
+    # pass 1: establish known points (add :ok completion or first read
+    # observation, whichever proves presence earliest in history order)
+    for op in h:
+        if op.f == "add" and op.is_ok:
+            el = elements[op.value]
+            if el.known_index is None:
+                el.known_index = op.index
+                el.known_time = op.time or 0
+    for ri, rt, ok_i, vset, _vals in reads:
+        for x in vset:
+            el = elements.setdefault(x, _Element(value=x))
+            if el.known_index is None or ok_i < el.known_index:
+                el.known_index = ok_i
+                el.known_time = rt
+
+    # pass 2: per element, scan reads invoked after the known point
+    for el in elements.values():
+        if el.known_index is None:
+            continue
+        for ri, rt, ok_i, vset, _vals in reads:
+            if ri <= el.known_index:
+                continue
+            if el.value in vset:
+                el.last_read_state = True
+                if el.absent and not el.present_after_absent:
+                    el.present_after_absent = True
+                    el.stale_until = rt
+            else:
+                el.absent.append(ri)
+                el.last_read_state = False
+
+    stable, lost, never_read, stale, unknown = [], [], [], [], []
+    attempts = 0
+    for x, el in sorted(elements.items(), key=lambda kv: repr(kv[0])):
+        if el.add_invoke is not None:
+            attempts += 1
+        if el.known_index is None:
+            if el.add_type == "ok":
+                never_read.append(x)     # confirmed added, never observed
+            elif el.add_type in ("info", None):
+                unknown.append(x)        # may never have happened
+            # fail: definitely absent; ignore
+            continue
+        if el.last_read_state is False:
+            lost.append(x)
+        elif el.absent:
+            stale.append(x)
+        elif el.last_read_state is None:
+            never_read.append(x)         # known but no read ever covered it
+        else:
+            stable.append(x)
+
+    worst_stale = []
+    for x in stale:
+        el = elements[x]
+        dur = (el.stale_until or 0) - (el.known_time or 0)
+        worst_stale.append({"element": x, "stale-ns": dur,
+                            "absent-reads": len(el.absent)})
+    worst_stale.sort(key=lambda d: -d["stale-ns"])
+
+    return {
+        "attempt-count": attempts,
+        "stable-count": len(stable),
+        "lost": lost, "lost-count": len(lost),
+        "stale": stale, "stale-count": len(stale),
+        "worst-stale": worst_stale[:8],
+        "never-read": never_read[:64], "never-read-count": len(never_read),
+        "unknown-count": len(unknown),
+        "duplicated": dict(sorted(duplicated.items(),
+                                  key=lambda kv: repr(kv[0]))[:16]),
+        "duplicated-count": sum(duplicated.values()),
+        "read-count": len(reads),
+    }
+
+
+class SetFull(Checker):
+    """checker/set-full analog; linearizable=True makes staleness illegal
+    (set.clj:46 passes {:linearizable? true})."""
+
+    def __init__(self, linearizable: bool = False):
+        self.linearizable = linearizable
+
+    def check(self, test, history, opts=None) -> dict:
+        res = analyze(history)
+        if res["read-count"] == 0:
+            valid: Any = "unknown"
+        elif res["lost-count"] or res["duplicated-count"] or (
+                self.linearizable and res["stale-count"]):
+            valid = False
+        elif res["stable-count"] == 0 and res["attempt-count"] > 0:
+            valid = "unknown"   # nothing confirmed either way
+        else:
+            valid = True
+        return {"valid?": valid, "linearizable?": self.linearizable, **res}
+
+
+def set_full(linearizable: bool = False) -> SetFull:
+    return SetFull(linearizable=linearizable)

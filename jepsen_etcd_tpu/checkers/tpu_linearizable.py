@@ -38,8 +38,18 @@ class TPULinearizableChecker(Checker):
             reason = None
         if p is not None and p.ok:
             out = wgl.check_packed(p, f_max=self.f_max)
-            if out["valid?"] != "unknown":
+            if out["valid?"] is True:
                 out["checker"] = "tpu-wgl"
+                return out
+            if out["valid?"] is False:
+                # attach the counterexample diagnostics (offending op,
+                # model error) the CPU oracle produces; violations are
+                # rare so the extra search is cheap
+                out["checker"] = "tpu-wgl"
+                cpu = check_history(self.model_fn(), history)
+                for k in ("op", "error", "max-linearized"):
+                    if k in cpu:
+                        out[k] = cpu[k]
                 return out
             reason = out.get("reason", "unknown")
         elif p is not None:

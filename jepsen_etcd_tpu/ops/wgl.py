@@ -91,7 +91,7 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
             return NONE_VAL
         key = repr(v)
         if key not in vid:
-            vid[key] = len(vid) + 1
+            vid[key] = max(vid.values(), default=NONE_VAL) + 1
         return vid[key]
 
     inv = np.array([e.invoke for e in req], dtype=np.int64)
@@ -150,8 +150,7 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
     static_ok = in_range & (pred[idx] <= d_idx)
 
     # predecessor bits within the frame: bit c <-> rank lo[d]+c
-    frame_rank = np.minimum(lo[:R][:, None] + b_idx, R - 1)   # same as idx
-    ret_frame = ret[frame_rank]                               # [R, W]
+    ret_frame = ret[idx]                                      # [R, W]
     inv_cand = inv[idx]                                       # [R, W]
     is_pred = (ret_frame[:, None, :] < inv_cand[:, :, None])  # [R, W, W]
     in_range_c = ((lo[:R][:, None] + b_idx) < R)[:, None, :]  # [R, 1, W]
@@ -159,7 +158,7 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
     pred_frame = ((is_pred & in_range_c) * bits).sum(-1).astype(np.uint32)
 
     is_upd = (f == WRITE) | (f == CAS)
-    upd_frame = is_upd[frame_rank] & in_range
+    upd_frame = is_upd[idx] & in_range
     upd_mask = (upd_frame * bits).sum(-1).astype(np.uint32)
     cum_upd = np.concatenate([[0], np.cumsum(is_upd)])
     u_forced = cum_upd[lo[:R]].astype(np.int32)

@@ -1,0 +1,110 @@
+"""Set-full checker unit tests + set workload end-to-end (SURVEY §7 step 8;
+reference semantics at set.clj and the library set-full checker)."""
+
+from jepsen_etcd_tpu.core.op import Op
+from jepsen_etcd_tpu.core.history import History
+from jepsen_etcd_tpu.checkers.set_full import SetFull
+from jepsen_etcd_tpu.compose import etcd_test
+from jepsen_etcd_tpu.runner.test_runner import run_test
+
+
+def H(*ops):
+    return History([Op(o) for o in ops])
+
+
+def add(p, x):
+    return ({"type": "invoke", "process": p, "f": "add", "value": x},
+            {"type": "ok", "process": p, "f": "add", "value": x})
+
+
+def add_info(p, x):
+    return ({"type": "invoke", "process": p, "f": "add", "value": x},
+            {"type": "info", "process": p, "f": "add", "value": x})
+
+
+def read(p, xs):
+    return ({"type": "invoke", "process": p, "f": "read", "value": None},
+            {"type": "ok", "process": p, "f": "read", "value": list(xs)})
+
+
+def flat(*pairs):
+    return [o for pair in pairs for o in pair]
+
+
+def test_stable_elements_valid():
+    h = H(*flat(add(0, 1), add(0, 2), read(1, [1, 2]), read(1, [1, 2])))
+    r = SetFull(linearizable=True).check({}, h)
+    assert r["valid?"] is True
+    assert r["stable-count"] == 2
+    assert r["lost-count"] == 0
+
+
+def test_lost_element_invalid():
+    # 2 is confirmed added, then vanishes from all later reads
+    h = H(*flat(add(0, 1), add(0, 2), read(1, [1, 2]), read(1, [1])))
+    r = SetFull().check({}, h)
+    assert r["valid?"] is False
+    assert r["lost"] == [2]
+
+
+def test_stale_read_only_fails_linearizable():
+    # 2 known at its add :ok, missing from the next read, back in the last:
+    # stale (flicker), illegal only in linearizable mode
+    h = H(*flat(add(0, 1), add(0, 2), read(1, [1]), read(1, [1, 2])))
+    assert SetFull(linearizable=True).check({}, h)["valid?"] is False
+    r = SetFull(linearizable=False).check({}, h)
+    assert r["valid?"] is True
+    assert r["stale"] == [2]
+    assert r["worst-stale"][0]["element"] == 2
+
+
+def test_info_add_never_observed_ok():
+    # indefinite add that never shows up: not lost, just unknown
+    h = H(*flat(add(0, 1), add_info(1, 9), read(2, [1]), read(2, [1])))
+    r = SetFull(linearizable=True).check({}, h)
+    assert r["valid?"] is True
+    assert r["unknown-count"] == 1
+
+
+def test_info_add_observed_then_lost_invalid():
+    # indefinite add observed by a read (=> it happened), then gone
+    h = H(*flat(add(0, 1), add_info(1, 9), read(2, [1, 9]), read(2, [1])))
+    r = SetFull().check({}, h)
+    assert r["valid?"] is False
+    assert r["lost"] == [9]
+
+
+def test_never_read_is_not_failure():
+    h = H(*flat(add(0, 1)))
+    r = SetFull(linearizable=True).check({}, h)
+    assert r["valid?"] == "unknown"  # no reads: no information
+    h2 = H(*flat(read(1, []), add(0, 1)))
+    r2 = SetFull(linearizable=True).check({}, h2)
+    assert r2["never-read-count"] == 1
+
+
+def test_duplicate_read_values_invalid():
+    h = H(*flat(add(0, 1), read(1, [1, 1])))
+    r = SetFull().check({}, h)
+    assert r["valid?"] is False
+    assert r["duplicated-count"] == 1
+
+
+def test_set_workload_e2e(tmp_path):
+    out = run_test(etcd_test({
+        "workload": "set", "time_limit": 6, "rate": 50,
+        "store_base": str(tmp_path), "seed": 11}))
+    assert out["valid?"] is True
+    wl = out["results"]["workload"]
+    assert wl["stable-count"] > 10
+    assert wl["lost-count"] == 0
+
+
+def test_set_workload_serializable_stale_reads(tmp_path):
+    # Node-local (serializable) reads can be stale; with a linearizable
+    # set-full check this must surface as staleness, not loss.
+    out = run_test(etcd_test({
+        "workload": "set", "time_limit": 8, "rate": 100,
+        "serializable": True, "store_base": str(tmp_path), "seed": 3}))
+    wl = out["results"]["workload"]
+    assert wl["lost-count"] == 0
