@@ -1,0 +1,13 @@
+"""Elle-analog transactional anomaly checkers (TPU cycle engine).
+
+The reference consumes the Elle library through ``append/test``
+(append.clj:183-185) and ``wr/test`` (wr.clj:87-92); these modules
+re-derive the two checkers — list-append and rw-register — with the
+dependency-graph cycle search running as a batched boolean-matmul
+transitive closure on TPU (ops/closure.py).
+"""
+
+from .append import ListAppendChecker
+from .wr import RWRegisterChecker
+
+__all__ = ["ListAppendChecker", "RWRegisterChecker"]

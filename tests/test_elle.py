@@ -1,0 +1,298 @@
+"""Elle checker tests: known-good and known-bad txn histories (the golden
+fixtures SURVEY §4 calls for), plus closure-kernel equivalence."""
+
+import numpy as np
+import pytest
+
+from jepsen_etcd_tpu.core.op import Op
+from jepsen_etcd_tpu.core.history import History
+from jepsen_etcd_tpu.checkers.elle.append import ListAppendChecker
+from jepsen_etcd_tpu.checkers.elle.wr import RWRegisterChecker
+from jepsen_etcd_tpu.ops.closure import closure_batch, _closure_numpy
+
+
+def H(*ops):
+    return History([Op(o) for o in ops])
+
+
+def inv(p, txn):
+    return {"type": "invoke", "process": p, "f": "txn", "value": txn}
+
+
+def ok(p, txn):
+    return {"type": "ok", "process": p, "f": "txn", "value": txn}
+
+
+def fail(p, txn):
+    return {"type": "fail", "process": p, "f": "txn", "value": txn,
+            "error": "didnt-succeed"}
+
+
+def info(p, txn):
+    return {"type": "info", "process": p, "f": "txn", "value": txn}
+
+
+def check_append(h, models=("strict-serializable",)):
+    return ListAppendChecker(consistency_models=models).check({}, h)
+
+
+def check_wr(h, models=("strict-serializable",)):
+    return RWRegisterChecker(consistency_models=models).check({}, h)
+
+
+# ---- list-append ----------------------------------------------------------
+
+def test_append_sequential_valid():
+    h = H(inv(0, [["append", "x", 1]]), ok(0, [["append", "x", 1]]),
+          inv(0, [["r", "x", None]]), ok(0, [["r", "x", [1]]]),
+          inv(1, [["append", "x", 2]]), ok(1, [["append", "x", 2]]),
+          inv(1, [["r", "x", None]]), ok(1, [["r", "x", [1, 2]]]))
+    r = check_append(h)
+    assert r["valid?"] is True, r
+    assert r["anomaly-types"] == []
+
+
+def test_append_g1c_circular_information_flow():
+    # T1 and T2 each read the other's append: wr cycle
+    h = H(inv(0, [["append", "x", 1], ["r", "y", None]]),
+          inv(1, [["append", "y", 1], ["r", "x", None]]),
+          ok(0, [["append", "x", 1], ["r", "y", [1]]]),
+          ok(1, [["append", "y", 1], ["r", "x", [1]]]))
+    r = check_append(h)
+    assert r["valid?"] is False
+    assert "G1c" in r["anomaly-types"], r["anomaly-types"]
+    cyc = r["anomalies"]["G1c"][0]
+    assert {s["type"] for s in cyc["steps"]} == {"wr"}
+
+
+def test_append_g_single_read_skew():
+    # T2 appends x and y; T1 sees y's new state but x's old state
+    h = H(inv(0, [["r", "x", None], ["r", "y", None]]),
+          inv(1, [["append", "x", 1], ["append", "y", 1]]),
+          ok(1, [["append", "x", 1], ["append", "y", 1]]),
+          ok(0, [["r", "x", []], ["r", "y", [1]]]),
+          inv(2, [["r", "x", None]]), ok(2, [["r", "x", [1]]]))
+    r = check_append(h)
+    assert r["valid?"] is False
+    assert "G-single" in r["anomaly-types"], r["anomaly-types"]
+
+
+def test_append_g0_write_cycle():
+    # interleaved append order between two keys; order fixed by reader
+    h = H(inv(0, [["append", "x", 1], ["append", "y", 2]]),
+          inv(1, [["append", "x", 2], ["append", "y", 1]]),
+          ok(0, [["append", "x", 1], ["append", "y", 2]]),
+          ok(1, [["append", "x", 2], ["append", "y", 1]]),
+          inv(2, [["r", "x", None], ["r", "y", None]]),
+          ok(2, [["r", "x", [1, 2]], ["r", "y", [1, 2]]]))
+    r = check_append(h)
+    assert r["valid?"] is False
+    assert "G0" in r["anomaly-types"], r["anomaly-types"]
+
+
+def test_append_stale_read_realtime_only():
+    # T2 invokes after T1 completed but misses T1's committed append:
+    # fine under serializable, a cycle only with realtime edges.
+    h = H(inv(0, [["append", "x", 1]]), ok(0, [["append", "x", 1]]),
+          inv(1, [["r", "x", None]]), ok(1, [["r", "x", []]]),
+          inv(2, [["r", "x", None]]), ok(2, [["r", "x", [1]]]))
+    r = check_append(h)
+    assert r["valid?"] is False
+    assert "G-single-realtime" in r["anomaly-types"], r["anomaly-types"]
+    # ...and valid under plain serializability
+    r2 = check_append(h, models=("serializable",))
+    assert r2["valid?"] is True, r2
+
+
+def test_append_g1a_aborted_read():
+    h = H(inv(0, [["append", "x", 1]]), fail(0, [["append", "x", 1]]),
+          inv(1, [["r", "x", None]]), ok(1, [["r", "x", [1]]]))
+    r = check_append(h)
+    assert "G1a" in r["anomaly-types"]
+
+
+def test_append_g1b_intermediate_read():
+    h = H(inv(0, [["append", "x", 1], ["append", "x", 2]]),
+          ok(0, [["append", "x", 1], ["append", "x", 2]]),
+          inv(1, [["r", "x", None]]), ok(1, [["r", "x", [1]]]))
+    r = check_append(h)
+    assert "G1b" in r["anomaly-types"]
+
+
+def test_append_internal():
+    # a read must reflect the txn's own earlier appends
+    h = H(inv(0, [["append", "x", 1], ["r", "x", None]]),
+          ok(0, [["append", "x", 1], ["r", "x", []]]))
+    r = check_append(h)
+    assert "internal" in r["anomaly-types"]
+
+
+def test_append_own_reads_ok():
+    h = H(inv(0, [["append", "x", 1], ["r", "x", None]]),
+          ok(0, [["append", "x", 1], ["r", "x", [1]]]))
+    assert check_append(h)["valid?"] is True
+
+
+def test_append_incompatible_order():
+    h = H(inv(0, [["r", "x", None]]), ok(0, [["r", "x", [1, 2]]]),
+          inv(1, [["r", "x", None]]), ok(1, [["r", "x", [2, 1]]]))
+    r = check_append(h)
+    assert "incompatible-order" in r["anomaly-types"]
+
+
+def test_append_duplicate_elements():
+    h = H(inv(0, [["r", "x", None]]), ok(0, [["r", "x", [1, 1]]]))
+    r = check_append(h)
+    assert "duplicate-elements" in r["anomaly-types"]
+
+
+def test_append_info_txn_observed_is_committed():
+    # an indeterminate append later observed joins the graph
+    h = H(inv(0, [["append", "x", 1]]), info(0, [["append", "x", 1]]),
+          inv(1, [["r", "x", None]]), ok(1, [["r", "x", [1]]]))
+    r = check_append(h)
+    assert r["valid?"] is True
+    assert r["committed-count"] == 2
+
+
+def test_append_coexisting_g0_and_g1c_both_reported():
+    # a ww cycle on keys x/y (txns 0,1) AND a separate wr cycle on keys
+    # p/q (txns 3,4): both anomaly types must surface, correctly labeled
+    h = H(inv(0, [["append", "x", 1], ["append", "y", 2]]),
+          inv(1, [["append", "x", 2], ["append", "y", 1]]),
+          ok(0, [["append", "x", 1], ["append", "y", 2]]),
+          ok(1, [["append", "x", 2], ["append", "y", 1]]),
+          inv(2, [["r", "x", None], ["r", "y", None]]),
+          ok(2, [["r", "x", [1, 2]], ["r", "y", [1, 2]]]),
+          inv(3, [["append", "p", 1], ["r", "q", None]]),
+          inv(4, [["append", "q", 1], ["r", "p", None]]),
+          ok(3, [["append", "p", 1], ["r", "q", [1]]]),
+          ok(4, [["append", "q", 1], ["r", "p", [1]]]))
+    r = check_append(h)
+    assert "G0" in r["anomaly-types"], r["anomaly-types"]
+    assert "G1c" in r["anomaly-types"], r["anomaly-types"]
+    # the G1c certificate must actually contain a wr edge
+    g1c = r["anomalies"]["G1c"][0]
+    assert any(s["type"] == "wr" for s in g1c["steps"])
+
+
+# ---- rw-register ----------------------------------------------------------
+
+def test_wr_sequential_valid():
+    h = H(inv(0, [["w", "x", 1]]), ok(0, [["w", "x", 1]]),
+          inv(1, [["r", "x", None]]), ok(1, [["r", "x", 1]]))
+    r = check_wr(h)
+    assert r["valid?"] is True, r
+
+
+def test_wr_internal():
+    h = H(inv(0, [["w", "x", 1], ["r", "x", None]]),
+          ok(0, [["w", "x", 1], ["r", "x", 2]]))
+    r = check_wr(h)
+    assert "internal" in r["anomaly-types"]
+
+
+def test_wr_g1a():
+    h = H(inv(0, [["w", "x", 1]]), fail(0, [["w", "x", 1]]),
+          inv(1, [["r", "x", None]]), ok(1, [["r", "x", 1]]))
+    r = check_wr(h)
+    assert "G1a" in r["anomaly-types"]
+
+
+def test_wr_g1c():
+    h = H(inv(0, [["w", "x", 1], ["r", "y", None]]),
+          inv(1, [["w", "y", 2], ["r", "x", None]]),
+          ok(0, [["w", "x", 1], ["r", "y", 2]]),
+          ok(1, [["w", "y", 2], ["r", "x", 1]]))
+    r = check_wr(h)
+    assert "G1c" in r["anomaly-types"], r["anomaly-types"]
+
+
+def test_wr_stale_read_realtime():
+    # committed write, then a later txn still reads nil
+    h = H(inv(0, [["w", "x", 1]]), ok(0, [["w", "x", 1]]),
+          inv(1, [["r", "x", None]]), ok(1, [["r", "x", None]]))
+    r = check_wr(h)
+    assert r["valid?"] is False
+    assert "G-single-realtime" in r["anomaly-types"], r["anomaly-types"]
+    assert check_wr(h, models=("serializable",))["valid?"] is True
+
+
+def test_wr_cyclic_version_order():
+    h = H(inv(0, [["r", "x", None], ["w", "x", 2]]),
+          ok(0, [["r", "x", 1], ["w", "x", 2]]),
+          inv(1, [["r", "x", None], ["w", "x", 1]]),
+          ok(1, [["r", "x", 2], ["w", "x", 1]]))
+    r = check_wr(h)
+    assert "cyclic-version-order" in r["anomaly-types"]
+
+
+def test_wr_wfr_inference():
+    # wfr: T0 reads x=1 then writes x=2 => 1 << 2; T1 read x=2 then
+    # x=1 again would be a non-repeatable read inside one txn
+    h = H(inv(0, [["w", "x", 1]]), ok(0, [["w", "x", 1]]),
+          inv(1, [["r", "x", None], ["w", "x", 2]]),
+          ok(1, [["r", "x", 1], ["w", "x", 2]]),
+          inv(2, [["r", "x", None], ["r", "x", None]]),
+          ok(2, [["r", "x", 2], ["r", "x", 1]]))
+    r = check_wr(h)
+    assert "internal" in r["anomaly-types"]
+
+
+# ---- closure kernel -------------------------------------------------------
+
+@pytest.mark.parametrize("n", [5, 40, 300])
+def test_closure_matches_numpy(n):
+    rng = np.random.default_rng(n)
+    a = rng.random((3, n, n)) < (2.0 / n)
+    ref_reach, ref_cyc = _closure_numpy(a)
+    reach, cyc = closure_batch(a, force_device=True)
+    assert np.array_equal(reach, ref_reach)
+    assert np.array_equal(cyc, ref_cyc)
+
+
+def test_closure_numpy_no_overflow_at_256_paths():
+    # 0 -> {1..256} -> 257: exactly 256 distinct paths; a uint8
+    # accumulator would wrap to 0 and lose the reachability
+    n = 258
+    a = np.zeros((1, n, n), bool)
+    a[0, 0, 1:257] = True
+    a[0, 1:257, 257] = True
+    reach, _ = _closure_numpy(a)
+    assert reach[0, 0, 257]
+
+
+def test_closure_empty():
+    reach, cyc = closure_batch(np.zeros((2, 0, 0), bool))
+    assert reach.shape == (2, 0, 0)
+
+
+def test_closure_simple_cycle():
+    a = np.zeros((1, 4, 4), bool)
+    a[0, 0, 1] = a[0, 1, 2] = a[0, 2, 0] = True  # 0->1->2->0; 3 isolated
+    reach, cyc = closure_batch(a)
+    assert cyc[0].tolist() == [True, True, True, False]
+    assert reach[0, 0, 2] and reach[0, 2, 1] and not reach[0, 3, 0]
+
+
+# ---- end-to-end against the simulated cluster -----------------------------
+
+def run(tmp_path, **opts):
+    from jepsen_etcd_tpu.compose import etcd_test
+    from jepsen_etcd_tpu.runner.test_runner import run_test
+    base = {"time_limit": 6, "rate": 50, "store_base": str(tmp_path),
+            "seed": 11}
+    base.update(opts)
+    return run_test(etcd_test(base))
+
+
+def test_wr_workload_e2e(tmp_path):
+    out = run(tmp_path, workload="wr")
+    assert out["valid?"] is True, out["results"]["workload"]["anomaly-types"]
+    assert out["results"]["workload"]["txn-count"] > 50
+
+
+def test_append_workload_e2e(tmp_path):
+    out = run(tmp_path, workload="append")
+    assert out["valid?"] is True, out["results"]["workload"]["anomaly-types"]
+    assert out["results"]["workload"]["txn-count"] > 50
