@@ -1,0 +1,95 @@
+"""Watch checker: all watchers saw the same values in the same order.
+
+Re-design of the reference checker (watch.clj:274-357):
+
+- group ok ``watch``/``final-watch`` ops by *thread* (``process mod
+  concurrency`` — processes recycle onto threads, watch.clj:281-282) and
+  concatenate their observed value logs;
+- pick a canonical log: the most common log, else the longest
+  (watch.clj:304-318);
+- any thread whose log differs (nonzero edit distance, computed by the
+  TPU wavefront kernel, ops/edit_distance.py) is a delta -> invalid;
+- any ``nonmonotonic-watch`` error in history -> invalid
+  (watch.clj:320-326, 347-350);
+- if threads' final revisions are unequal the test didn't converge, so
+  missing entries prove nothing: verdict :unknown (watch.clj:348-351).
+"""
+
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+from typing import Optional
+
+from ..core.history import History
+from ..ops.edit_distance import edit_distance, diff_report
+from .core import Checker
+
+
+def per_thread_watches(test, history) -> dict:
+    conc = test.get("concurrency", 1) if isinstance(test, dict) else 1
+    h = history if isinstance(history, History) else History(history)
+    out: dict = defaultdict(list)
+    for op in h.client_ops():
+        if op.is_ok and op.get("f") in ("watch", "final-watch"):
+            out[op["process"] % conc].append(op)
+    return dict(out)
+
+
+def per_thread_logs(test, history) -> dict:
+    return {thread: [v for op in ops
+                     for v in ((op.value or {}).get("log") or [])]
+            for thread, ops in per_thread_watches(test, history).items()}
+
+
+def per_thread_revisions(test, history) -> dict:
+    return {thread: max([(op.value or {}).get("revision", 0)
+                         for op in ops] + [0])
+            for thread, ops in per_thread_watches(test, history).items()}
+
+
+def canonical_log(logs: list) -> list:
+    """The mode log if one repeats, else the longest (watch.clj:304-318)."""
+    if not logs:
+        return []
+    counts = Counter(tuple(l) for l in logs)
+    (top, freq), = counts.most_common(1)
+    if freq > 1:
+        return list(top)
+    return max(logs, key=len)
+
+
+class WatchChecker(Checker):
+    def __init__(self, use_tpu: Optional[bool] = None):
+        self.use_tpu = use_tpu
+
+    def check(self, test, history, opts=None) -> dict:
+        h = history if isinstance(history, History) else History(history)
+        logs = per_thread_logs(test, h)
+        revisions = per_thread_revisions(test, h)
+        canonical = canonical_log(list(logs.values()))
+        deltas = []
+        for thread, log in sorted(logs.items()):
+            ed = edit_distance(canonical, log,
+                               force_device=self.use_tpu)
+            if ed:
+                deltas.append({"thread": thread, "edit-distance": ed,
+                               "diff": diff_report(canonical, log)})
+        deltas.sort(key=lambda d: -d["edit-distance"])
+        nm_errors = [op["error"] for op in h
+                     if isinstance(op.get("error"), (list, tuple))
+                     and op["error"] and op["error"][0] == "nonmonotonic-watch"]
+        if nm_errors:
+            valid = False
+        elif len(set(revisions.values())) > 1:
+            valid = "unknown"
+        elif deltas:
+            valid = False
+        else:
+            valid = True
+        out = {"valid?": valid, "revisions": revisions}
+        if valid is not True:
+            out.update({"logs": {t: l[:200] for t, l in logs.items()},
+                        "canonical": canonical[:200],
+                        "deltas": deltas[:8],
+                        "nonmonotonic-errors": nm_errors[:8]})
+        return out

@@ -27,20 +27,14 @@ from functools import partial
 
 import numpy as np
 
-try:
+from .common import HAVE_JAX, bucket as _bucket, use_device
+
+if HAVE_JAX:
     import jax
     import jax.numpy as jnp
-    HAVE_JAX = True
-except Exception:  # pragma: no cover - jax is baked in
-    HAVE_JAX = False
 
 #: below this node count, numpy squaring beats a device round-trip
 CPU_CUTOFF = 256
-
-
-def _bucket(n: int, minimum: int = 128) -> int:
-    """Pad to the next power of two (min 128) for jit-cache stability."""
-    return max(minimum, 1 << max(0, math.ceil(math.log2(max(1, n)))))
 
 
 if HAVE_JAX:
@@ -93,12 +87,7 @@ def closure_batch(adj: np.ndarray, force_device: bool | None = None):
     b, n, _ = adj.shape
     if n == 0:
         return (np.zeros((b, 0, 0), bool), np.zeros((b, 0), bool))
-    if force_device and not HAVE_JAX:
-        raise RuntimeError("closure_batch(force_device=True) but jax is "
-                           "unavailable")
-    use_device = HAVE_JAX and force_device is not False \
-        and (force_device or n >= CPU_CUTOFF)
-    if not use_device:
+    if not use_device(force_device, n, CPU_CUTOFF, "closure_batch"):
         return _closure_numpy(adj)
     m = _bucket(n)
     pad = np.zeros((b, m, m), dtype=bool)

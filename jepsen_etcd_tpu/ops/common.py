@@ -1,0 +1,35 @@
+"""Shared kernel scaffolding: jit-cache bucketing and device dispatch.
+
+Every TPU kernel in this package pads its inputs to bucketed power-of-two
+shapes (so jit caches stay warm across histories) and falls back to a
+host implementation below a size cutoff (device dispatch would dominate).
+"""
+
+from __future__ import annotations
+
+import math
+
+try:
+    import jax  # noqa: F401
+    HAVE_JAX = True
+except Exception:  # pragma: no cover - jax is baked in
+    HAVE_JAX = False
+
+
+def bucket(n: int, minimum: int = 128) -> int:
+    """Pad to the next power of two (min `minimum`)."""
+    return max(minimum, 1 << max(0, math.ceil(math.log2(max(1, n)))))
+
+
+def use_device(force_device: bool | None, n: int, cutoff: int,
+               what: str) -> bool:
+    """Resolve the force_device tri-state against availability and size.
+
+    force_device=True demands the device (error without jax);
+    False forces the host path; None picks by size.
+    """
+    if force_device and not HAVE_JAX:
+        raise RuntimeError(f"{what}(force_device=True) but jax is "
+                           "unavailable")
+    return HAVE_JAX and force_device is not False \
+        and (bool(force_device) or n >= cutoff)

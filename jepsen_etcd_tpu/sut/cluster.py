@@ -562,6 +562,18 @@ class Cluster:
         peer.fsync()
         peer.applied_since_snap = 0
         peer.log_line(f"installed snapshot at index {peer.snap_index}")
+        # a snapshot replaces the store without applying the skipped
+        # entries, so sync watchers from the new store's event history
+        # (etcd's watchableStore catches unsynced watchers up from the
+        # MVCC backend; only compaction can actually lose them events)
+        for w in list(peer.watchers):
+            try:
+                backlog = peer.store.events_since(w.next_rev)
+            except SimError as e:
+                w.cancel(e)
+                continue
+            if backlog:
+                w.feed(backlog)
 
     def _advance_commit(self, leader: Node) -> None:
         if leader.role != "leader":

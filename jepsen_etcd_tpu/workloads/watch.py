@@ -1,5 +1,243 @@
-"""Placeholder: the watch workload lands with the full workload suite."""
+"""Watch workload: watchers must observe identical, ordered value streams.
+
+Re-design of ``watch.clj``: the first node-count threads bump one key
+``"w"`` with increasing ints; the remaining threads watch it and log the
+value sequences they observe. The checker verifies every watcher saw the
+same values in the same order (edit distance vs a canonical log,
+watch.clj:328-357) and that no watch stream ever delivered a
+non-monotonic revision (watch.clj:161-177 throws a *definite*
+``:nonmonotonic-watch`` so the op lands in history as an error).
+
+The final phase converges: every watcher repeatedly re-watches until all
+watchers reach the same revision (custom converger barrier,
+watch.clj:20-137), with a 60 s cap (watch.clj:245-246).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Optional
+
+from ..core.op import Op
+from ..client import with_errors
+from ..checkers.watch import WatchChecker
+from ..generators import reserve, each_thread
+from ..runner.sim import current_loop, sleep, Event, SECOND
+from ..sut.errors import SimError
+from .base import WorkloadClient
+
+KEY = "w"
+MS = 1_000_000
+
+_INIT = ("init",)
+_EVOLVING = ("evolving",)
 
 
-def workload(opts):
-    raise NotImplementedError("watch workload not yet implemented")
+class ConvergeTimeout(Exception):
+    """Deadline passed; carries the thread's partial value."""
+
+    def __init__(self, value):
+        super().__init__("converge timeout")
+        self.value = value
+
+
+class ConvergeBroken(Exception):
+    """Another participant crashed (the BrokenBarrierException analog)."""
+
+
+class Converger:
+    """N tasks evolve values until (converged? values) holds for all
+    non-evolving values and none are initial (watch.clj:20-137)."""
+
+    def __init__(self, n: int, converged: Callable[[list], bool]):
+        self.n = n
+        self.converged_fn = converged
+        self.values: list = [_INIT] * n
+        self.crashed = False
+        self._next_index = 0
+        self._change: Optional[Event] = None
+
+    def _signal(self) -> None:
+        if self._change is not None:
+            self._change.set()
+            self._change = None
+
+    def _stable(self) -> bool:
+        return not any(v is _INIT or v is _EVOLVING for v in self.values)
+
+    def _divergent(self) -> bool:
+        if any(v is _INIT for v in self.values):
+            return True
+        vs = [v for v in self.values if v is not _EVOLVING]
+        return bool(vs) and not self.converged_fn(vs)
+
+    def _converged(self) -> bool:
+        return self._stable() and not self._divergent()
+
+    async def converge(self, timeout_ns: int, init: Any,
+                       evolve: Callable) -> Any:
+        """Register this task (index = arrival order) and evolve until
+        all participants converge. Raises ConvergeTimeout (with the
+        partial value) or ConvergeBroken."""
+        loop = current_loop()
+        deadline = loop.now + timeout_ns
+        i = self._next_index
+        self._next_index += 1
+        while True:
+            if self.crashed:
+                raise ConvergeBroken("convergence failed")
+            if self._converged():
+                return self.values[i]
+            if loop.now >= deadline:
+                raise ConvergeTimeout(self.values[i])
+            if self._divergent():
+                v = self.values[i]
+                v = init if v is _INIT else v
+                self.values[i] = _EVOLVING
+                try:
+                    self.values[i] = await evolve(v)
+                except BaseException:
+                    self.crashed = True
+                    raise
+                finally:
+                    self._signal()
+            else:
+                # create the Event synchronously BEFORE yielding: a signal
+                # fired between here and the await would otherwise be lost
+                if self._change is None:
+                    self._change = Event(loop)
+                ev = self._change
+                timer = loop.call_later(max(0, deadline - loop.now), ev.set)
+                try:
+                    await ev.wait()
+                finally:
+                    timer.cancel()
+
+
+class WatchClient(WorkloadClient):
+    def __init__(self):
+        super().__init__()
+        self.max_revision = [0]      # shared across all opens (an atom)
+        self.converger: Optional[Converger] = None
+
+    def open(self, test: dict, node: str) -> "WatchClient":
+        new = super().open(test, node)
+        new.revision = [0]           # per-client (per-process) revision
+        return new
+
+    # -- watch plumbing ------------------------------------------------------
+
+    async def watch_for(self, revision: int, ms: int) -> dict:
+        """Watch KEY from revision (exclusive) for ms; returns
+        {revision, log} or raises the stream's error
+        (watch.clj:139-212)."""
+        state = {"revision": revision, "log": []}
+        errors: list = []
+
+        def on_events(events):
+            if errors:
+                return
+            vals = [e.kv["value"] if e.kv else None for e in events]
+            rev2 = max(e.revision for e in events)
+            if not state["revision"] < rev2:
+                errors.append(SimError(
+                    "nonmonotonic-watch",
+                    f"got event with revision {rev2} but we last saw "
+                    f"{state['revision']}", definite=True))
+                w.cancel()
+                return
+            state["revision"] = rev2
+            state["log"].extend(vals)
+
+        def on_error(e):
+            errors.append(e)
+
+        # revision is inclusive in the API, so start just past what we
+        # have (and never pass 0, which means "from now")
+        w = self.conn.watch(KEY, revision + 1, on_events, on_error)
+        await sleep(ms * MS)
+        w.cancel()
+        if errors:
+            raise errors[0]
+        return state
+
+    def _track(self, res: dict) -> None:
+        self.revision[0] = res["revision"]
+        self.max_revision[0] = max(self.max_revision[0], res["revision"])
+
+    # -- ops -----------------------------------------------------------------
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        loop = current_loop()
+
+        async def go():
+            if op.f == "write":
+                res = await self.conn.put(KEY, op.value)
+                self.max_revision[0] = max(self.max_revision[0],
+                                           res["header"]["revision"])
+                return op.evolve(type="ok")
+
+            if op.f == "watch":
+                res = await self.watch_for(self.revision[0],
+                                           loop.rng.randint(0, 5000))
+                self._track(res)
+                return op.evolve(type="ok", value=res)
+
+            if op.f == "final-watch":
+                async def evolve(v):
+                    try:
+                        w = await self.watch_for(
+                            v["revision"], loop.rng.randint(0, 5000))
+                        self._track(w)
+                        return {"revision": w["revision"],
+                                "log": v["log"] + w["log"]}
+                    except (SimError, TimeoutError) as e:
+                        if isinstance(e, SimError) and e.definite:
+                            raise  # nonmonotonic etc: surface it
+                        await sleep(1 * SECOND)
+                        return v
+                try:
+                    v = await self.converger.converge(
+                        60 * SECOND,
+                        {"revision": self.revision[0], "log": []}, evolve)
+                    return op.evolve(type="ok", value=v)
+                except ConvergeTimeout as e:
+                    val = None if e.value in (_INIT, _EVOLVING) else e.value
+                    return op.evolve(type="ok", value=val,
+                                     error=["converge-timeout"])
+            raise ValueError(f"unknown f {op.f}")
+
+        # watch ops must fail definitely: an indefinite error would spin
+        # up a fresh client whose re-watch duplicates log entries
+        return await with_errors(op, {"watch", "final-watch"}, go)
+
+
+def workload(opts: dict) -> dict:
+    node_count = len(opts["nodes"])
+    concurrency = opts.get("concurrency") or 2 * node_count
+    watch_count = max(1, concurrency - node_count)
+    client = WatchClient()
+
+    def converged(ms: list) -> bool:
+        # all watchers agree AND have reached the highest revision any
+        # writer observed — equality alone would let every watcher
+        # converge at the same stale revision, masking a common-tail loss
+        revs = {m["revision"] for m in ms}
+        return len(revs) == 1 and min(revs) >= client.max_revision[0]
+
+    client.converger = Converger(watch_count, converged)
+    counter = itertools.count()
+
+    def write(test, ctx):
+        return {"f": "write", "value": next(counter)}
+
+    def watch(test, ctx):
+        return {"f": "watch", "value": None}
+
+    return {
+        "client": client,
+        "checker": WatchChecker(),
+        "generator": reserve(node_count, write, watch),
+        "final_generator": reserve(
+            node_count, None, each_thread({"f": "final-watch"})),
+    }

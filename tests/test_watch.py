@@ -1,0 +1,223 @@
+"""Watch workload tests: converger convergence + crash propagation
+(mirroring the reference's watch_test.clj:9-35), the edit-distance
+kernel, the watch checker, and an end-to-end run."""
+
+import pytest
+
+from jepsen_etcd_tpu.core.op import Op
+from jepsen_etcd_tpu.core.history import History
+from jepsen_etcd_tpu.runner.sim import SimLoop, set_current_loop, sleep
+from jepsen_etcd_tpu.workloads.watch import Converger, ConvergeBroken, \
+    ConvergeTimeout
+from jepsen_etcd_tpu.ops.edit_distance import (edit_distance,
+                                               _indel_python)
+from jepsen_etcd_tpu.checkers.watch import WatchChecker, canonical_log
+
+SECOND = 1_000_000_000
+
+
+# ---- converger ------------------------------------------------------------
+
+@pytest.fixture
+def sim_loop():
+    yield
+    set_current_loop(None)
+
+
+def _loop(seed):
+    l = SimLoop(seed=seed)
+    set_current_loop(l)
+    return l
+
+
+def test_converger_basics(sim_loop):
+    # append random numbers to lists until all final numbers agree
+    # (watch_test.clj:11-22)
+    loop = _loop(5)
+    n = 3
+    c = Converger(n, lambda vs: len({v[-1] for v in vs}) == 1)
+    results = []
+
+    async def worker(i):
+        async def evolve(coll):
+            await sleep(loop.rng.randint(0, 2_000_000))
+            return coll + [loop.rng.randint(0, 1)]
+        results.append((i, await c.converge(60 * SECOND, [i], evolve)))
+
+    for i in range(n):
+        loop.spawn(worker(i), f"w{i}")
+    loop.run()
+    assert len(results) == n
+    # starts with initial values, ends converged
+    for i, v in results:
+        assert v[0] == i
+    assert len({v[-1] for _, v in results}) == 1
+
+
+def test_converger_crash_propagates(sim_loop):
+    loop = _loop(6)
+    n = 3
+    c = Converger(n, lambda vs: len(set(vs)) == 1)
+    outcomes = {}
+
+    async def worker(i):
+        async def evolve(v):
+            await sleep(1_000_000)
+            if i == 1:
+                raise RuntimeError("hi")
+            return loop.rng.randint(0, 1)
+        try:
+            outcomes[i] = ("ok", await c.converge(60 * SECOND, i, evolve))
+        except RuntimeError as e:
+            outcomes[i] = ("raised", str(e))
+        except ConvergeBroken:
+            outcomes[i] = ("broken", None)
+
+    for i in range(n):
+        loop.spawn(worker(i), f"w{i}")
+    loop.run()
+    assert outcomes[1] == ("raised", "hi")
+    assert outcomes[0][0] == "broken"
+    assert outcomes[2][0] == "broken"
+
+
+def test_converger_timeout_returns_partial(sim_loop):
+    loop = _loop(7)
+    c = Converger(2, lambda vs: len(set(vs)) == 1)
+    out = {}
+
+    async def worker(i):
+        async def evolve(v):
+            await sleep(SECOND)
+            return i  # never converges: 0 vs 1
+        try:
+            out[i] = await c.converge(5 * SECOND, i, evolve)
+        except ConvergeTimeout as e:
+            out[i] = ("timeout", e.value)
+
+    for i in range(2):
+        loop.spawn(worker(i), f"w{i}")
+    loop.run()
+    assert any(isinstance(v, tuple) and v[0] == "timeout"
+               for v in out.values())
+
+
+def test_converger_same_instant_wakeups(sim_loop):
+    # two participants whose evolves complete at the same sim instant:
+    # the signal must not be lost between spawn and first await
+    loop = _loop(8)
+    c = Converger(2, lambda vs: len(set(vs)) == 1)
+    out = {}
+
+    async def worker(i):
+        async def evolve(v):
+            await sleep(SECOND)  # identical, deterministic durations
+            return 7
+        out[i] = await c.converge(60 * SECOND, i, evolve)
+
+    for i in range(2):
+        loop.spawn(worker(i), f"w{i}")
+    loop.run()
+    assert out == {0: 7, 1: 7}
+    assert loop.now < 10 * SECOND  # converged promptly, not via deadline
+
+
+# ---- edit distance --------------------------------------------------------
+
+def test_indel_basics():
+    assert _indel_python([], []) == 0
+    assert _indel_python([1, 2, 3], [1, 2, 3]) == 0
+    assert _indel_python([1, 2, 3], [1, 3]) == 1
+    assert _indel_python([1, 2], [3, 4]) == 4
+    assert _indel_python([1, 2, 3], [2, 3, 4]) == 2
+
+
+@pytest.mark.parametrize("n,m", [(0, 5), (7, 7), (40, 37), (200, 190)])
+def test_edit_distance_kernel_matches_python(n, m):
+    import numpy as np
+    rng = np.random.default_rng(n * 100 + m)
+    a = list(rng.integers(0, 5, n))
+    b = list(rng.integers(0, 5, m))
+    assert edit_distance(a, b, force_device=True) == _indel_python(a, b)
+
+
+def test_edit_distance_on_strings():
+    assert edit_distance(list("kitten"), list("sitting"),
+                         force_device=True) == 5  # indel (no substitution)
+
+
+# ---- checker --------------------------------------------------------------
+
+def H(*ops):
+    return History([Op(o) for o in ops])
+
+
+def watch_ok(p, log, rev):
+    return {"type": "ok", "process": p, "f": "watch",
+            "value": {"revision": rev, "log": log}}
+
+
+def watch_inv(p):
+    return {"type": "invoke", "process": p, "f": "watch", "value": None}
+
+
+def test_canonical_log_mode_beats_longest():
+    assert canonical_log([[1, 2], [1, 2], [1, 2, 3]]) == [1, 2]
+    assert canonical_log([[1], [1, 2, 3]]) == [1, 2, 3]
+
+
+def test_watch_checker_identical_logs_valid():
+    h = H(watch_inv(0), watch_ok(0, [1, 2, 3], 5),
+          watch_inv(1), watch_ok(1, [1, 2, 3], 5))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] is True
+
+
+def test_watch_checker_divergent_logs_invalid():
+    h = H(watch_inv(0), watch_ok(0, [1, 2, 3], 5),
+          watch_inv(1), watch_ok(1, [1, 3, 2], 5),
+          watch_inv(2), watch_ok(2, [1, 2, 3], 5))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] is False
+    assert r["deltas"][0]["thread"] == 1
+    assert r["deltas"][0]["edit-distance"] == 2
+
+
+def test_watch_checker_unequal_revisions_unknown():
+    h = H(watch_inv(0), watch_ok(0, [1, 2], 4),
+          watch_inv(1), watch_ok(1, [1, 2, 3], 5))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] == "unknown"
+
+
+def test_watch_checker_nonmonotonic_invalid():
+    h = H(watch_inv(0), watch_ok(0, [1], 5),
+          watch_inv(1),
+          {"type": "fail", "process": 1, "f": "watch",
+           "error": ["nonmonotonic-watch", "went backwards"]})
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] is False
+    assert r["nonmonotonic-errors"]
+
+
+def test_watch_checker_threads_fold_processes():
+    # process 9 with concurrency 4 is thread 1: logs concatenate
+    h = H(watch_inv(1), watch_ok(1, [1, 2], 3),
+          watch_inv(9), watch_ok(9, [3, 4], 9),
+          watch_inv(2), watch_ok(2, [1, 2, 3, 4], 9))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] is True
+
+
+# ---- end-to-end -----------------------------------------------------------
+
+def test_watch_workload_e2e(tmp_path):
+    from jepsen_etcd_tpu.compose import etcd_test
+    from jepsen_etcd_tpu.runner.test_runner import run_test
+    out = run_test(etcd_test({
+        "workload": "watch", "time_limit": 8, "rate": 50,
+        "store_base": str(tmp_path), "seed": 13}))
+    wl = out["results"]["workload"]
+    assert wl["valid?"] is True, wl
+    # watchers actually observed writes
+    assert sum(wl["revisions"].values()) > 0
