@@ -15,8 +15,18 @@ from .compose import etcd_test, default_opts
 from .workloads import workloads, WORKLOADS_EXPECTED_TO_PASS
 from .runner.test_runner import run_test
 
-ALL_NEMESES = [[], ["pause"], ["kill"], ["partition"], ["clock"],
-               ["member"], ["corrupt"], ["admin"]]  # etcd.clj:60-73
+# nemesis combinations swept by test-all (etcd.clj:60-73)
+ALL_NEMESES = [
+    ["admin"],
+    ["pause", "admin"],
+    ["kill", "admin"],
+    ["partition", "admin"],
+    ["member", "admin"],
+    ["bitflip-wal", "bitflip-snap", "admin"],
+    ["bitflip-wal", "bitflip-snap", "kill"],
+    ["admin", "bitflip-snap", "bitflip-wal", "pause", "kill", "partition",
+     "clock", "member"],
+]
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -53,7 +63,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 SPECIAL_NEMESES = {  # etcd.clj:75-80
     "none": [],
-    "all": ["pause", "kill", "partition", "clock", "member"],
+    "corrupt": ["bitflip-wal", "bitflip-snap", "truncate-wal"],
+    "all": ["admin", "pause", "kill", "bitflip-wal", "bitflip-snap",
+            "truncate-wal", "partition", "clock", "member"],
 }
 
 

@@ -1,0 +1,70 @@
+"""Lock workload tests: healthy-cluster runs pass, the mutex model
+rejects double-holds, and the error-coercion rules match lock.clj."""
+
+import pytest
+
+from jepsen_etcd_tpu.core.op import Op
+from jepsen_etcd_tpu.core.history import History
+from jepsen_etcd_tpu.checkers.linearizable import check_history
+from jepsen_etcd_tpu.models import Mutex
+from jepsen_etcd_tpu.workloads.lock import _is_not_held
+
+
+def H(*ops):
+    return History([Op(o) for o in ops])
+
+
+def test_mutex_model_rejects_double_acquire():
+    h = H({"type": "invoke", "process": 0, "f": "acquire", "value": None},
+          {"type": "ok", "process": 0, "f": "acquire", "value": None},
+          {"type": "invoke", "process": 1, "f": "acquire", "value": None},
+          {"type": "ok", "process": 1, "f": "acquire", "value": None})
+    assert check_history(Mutex(), h)["valid?"] is False
+
+
+def test_mutex_model_accepts_handoff():
+    h = H({"type": "invoke", "process": 0, "f": "acquire", "value": None},
+          {"type": "ok", "process": 0, "f": "acquire", "value": None},
+          {"type": "invoke", "process": 0, "f": "release", "value": None},
+          {"type": "ok", "process": 0, "f": "release", "value": None},
+          {"type": "invoke", "process": 1, "f": "acquire", "value": None},
+          {"type": "ok", "process": 1, "f": "acquire", "value": None})
+    assert check_history(Mutex(), h)["valid?"] is True
+
+
+def test_is_not_held_shapes():
+    assert _is_not_held("not-held")
+    assert _is_not_held(["not-held", "not-held: k"])
+    assert not _is_not_held(["timeout", "x"])
+    assert not _is_not_held(None)
+
+
+def run(tmp_path, **opts):
+    from jepsen_etcd_tpu.compose import etcd_test
+    from jepsen_etcd_tpu.runner.test_runner import run_test
+    base = {"time_limit": 10, "rate": 5, "store_base": str(tmp_path),
+            "seed": 17}
+    base.update(opts)
+    return run_test(etcd_test(base))
+
+
+def test_lock_workload_healthy_passes(tmp_path):
+    # without faults, etcd locks do exclude; acquire/release linearizes
+    out = run(tmp_path, workload="lock")
+    wl = out["results"]["workload"]
+    assert wl["linear"]["valid?"] is True, wl["linear"]
+    stats = out["results"]["stats"]["by-f"]
+    assert stats.get("acquire", {}).get("ok", 0) > 0
+    assert stats.get("release", {}).get("ok", 0) > 0
+
+
+def test_lock_set_workload_healthy_passes(tmp_path):
+    out = run(tmp_path, workload="lock-set", time_limit=12)
+    wl = out["results"]["workload"]
+    assert wl["set"]["valid?"] is True, wl["set"]
+
+
+def test_lock_etcd_set_workload_healthy_passes(tmp_path):
+    out = run(tmp_path, workload="lock-etcd-set", time_limit=12)
+    wl = out["results"]["workload"]
+    assert wl["set"]["valid?"] is True, wl["set"]

@@ -1,0 +1,110 @@
+"""Nemesis suite tests: every fault package runs end-to-end, safety
+holds for linearizable workloads under faults, and the lock workloads
+demonstrably break under pause faults (the reference's raison d'être:
+etcd locks are unsafe, lock.clj)."""
+
+import pytest
+
+from jepsen_etcd_tpu.compose import etcd_test
+from jepsen_etcd_tpu.runner.test_runner import run_test
+from jepsen_etcd_tpu.cli import parse_nemesis_spec
+
+
+def run(tmp_path, **opts):
+    base = {"time_limit": 20, "rate": 25, "ops_per_key": 50,
+            "store_base": str(tmp_path), "seed": 3,
+            "nemesis_interval": 3}
+    base.update(opts)
+    return run_test(etcd_test(base))
+
+
+def test_parse_nemesis_spec():
+    assert parse_nemesis_spec("kill,pause") == ["kill", "pause"]
+    assert parse_nemesis_spec("none") == []
+    assert "bitflip-wal" in parse_nemesis_spec("corrupt")
+    assert "member" in parse_nemesis_spec("all")
+
+
+def nemesis_fs(history):
+    return {op.f for op in history if op.get("process") == "nemesis"
+            and op.get("type") == "info"}
+
+
+def test_register_under_kill(tmp_path):
+    out = run(tmp_path, workload="register", nemesis=["kill"])
+    assert out["results"]["workload"]["valid?"] is True, \
+        "kill faults must not break linearizability"
+    assert {"kill", "start"} & nemesis_fs(out["history"])
+
+
+def test_register_under_partition(tmp_path):
+    out = run(tmp_path, workload="register", nemesis=["partition"])
+    assert out["results"]["workload"]["valid?"] is True, \
+        "partitions must not break linearizability"
+    assert "start-partition" in nemesis_fs(out["history"])
+
+
+def test_register_under_pause_clock(tmp_path):
+    out = run(tmp_path, workload="register", nemesis=["pause", "clock"])
+    assert out["results"]["workload"]["valid?"] is True
+    fs = nemesis_fs(out["history"])
+    assert "pause" in fs
+    assert fs & {"bump-clock", "strobe-clock", "reset-clock"}
+
+
+def test_register_under_member(tmp_path):
+    out = run(tmp_path, workload="register", nemesis=["member"],
+              time_limit=25)
+    assert out["results"]["workload"]["valid?"] is True
+    assert {"grow", "shrink"} & nemesis_fs(out["history"])
+    # the healing phase grew the cluster back to full strength
+    test = out["results"]
+    db_members = out["history"]  # via run's test map
+    # (membership is checked through the cluster state below)
+
+
+def test_member_heals_to_full(tmp_path):
+    test = etcd_test({"workload": "register", "nemesis": ["member"],
+                      "time_limit": 25, "rate": 25, "ops_per_key": 50,
+                      "store_base": str(tmp_path), "seed": 5,
+                      "nemesis_interval": 3})
+    out = run_test(test)
+    assert len(test["db"].members) >= len(test["nodes"])
+
+
+def test_set_under_admin_compact(tmp_path):
+    out = run(tmp_path, workload="set", nemesis=["admin"])
+    assert out["results"]["workload"]["valid?"] is True
+    assert {"compact", "defrag"} & nemesis_fs(out["history"])
+
+
+def test_append_under_kill_bitflip(tmp_path):
+    out = run(tmp_path, workload="append",
+              nemesis=["kill", "bitflip-wal", "bitflip-snap"],
+              time_limit=25)
+    wl = out["results"]["workload"]
+    assert wl["valid?"] is True, wl.get("anomaly-types")
+
+
+def test_watch_under_kill(tmp_path):
+    out = run(tmp_path, workload="watch", nemesis=["kill"])
+    wl = out["results"]["workload"]
+    # kills can prevent convergence (unknown) but must never produce
+    # divergent ordered logs or nonmonotonic revisions
+    assert wl["valid?"] in (True, "unknown"), wl
+
+
+def test_lock_set_breaks_under_clock_faults(tmp_path):
+    # The headline demonstration (lock.clj): skewing the leader's clock
+    # expires the holder's lease mid-critical-section; a second holder
+    # acquires; read-modify-write interleaves; adds are lost.
+    failures = 0
+    for seed in range(2):
+        out = run(tmp_path, workload="lock-set", nemesis=["clock"],
+                  time_limit=60, rate=10, seed=seed,
+                  nemesis_interval=2)
+        wl = out["results"]["workload"]["set"]
+        if wl["valid?"] is not True and wl.get("lost"):
+            failures += 1
+    assert failures > 0, \
+        "etcd locks should demonstrably fail under clock faults"
