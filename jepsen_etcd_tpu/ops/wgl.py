@@ -7,26 +7,33 @@ concurrency, sort the must-linearize (:ok) ops by invocation; then any
 reachable "linearized set" consists of a *forced prefix* plus a bitmask
 over a sliding window of at most W undecided ops. A search state packs to
 
-    (depth d, uint32 window mask, model value id)
+    (depth d, uint32 window mask, uint32 info mask, model value id)
 
-and a BFS wave over depth d is a dense [F, W] tensor expansion:
-- enabled = window bit clear ∧ precomputed predecessor-mask bits set,
-- model step = table-driven versioned-register transition
-  (version is *derived*: forced-prefix update count + popcount of update
-  bits in the window — no per-state version storage),
-- window slide = shift by (lo[d+1]-lo[d]) with shifted-out-bits-must-be-
-  set pruning,
-- dedup = 2-key lax.sort + neighbor-compare + scatter compaction.
+and a BFS wave is a dense [F, W + I] tensor expansion:
+- required candidates: window bit clear ∧ precomputed predecessor-mask
+  bits set, model step table-driven (version is *derived*: forced-prefix
+  update count + popcount of update bits in the window + popcount of the
+  info mask — no per-state version storage), window slide by
+  (lo[d+1]-lo[d]) with shifted-out-bits-must-be-set pruning;
+- info (indefinite) candidates: a crashed/timed-out update may linearize
+  at any point after all :ok ops that returned before its invoke, or
+  never (Knossos semantics, checkers/linearizable.py). Each kept info op
+  owns one bit of the info mask; linearizing it keeps d, sets its bit,
+  bumps the derived version, and moves the value. Info *reads* and info
+  ops invoked after the last required return are dropped up front — they
+  can never influence a required op's verdict.
+- dedup = 4-key lax.sort + neighbor-compare + scatter compaction. Every
+  successor's (d + popcount(info mask)) is exactly one greater than its
+  parent's, so waves are strict BFS levels and no state recurs across
+  waves — dedup within a wave is complete dedup.
 
-The wave loop is a lax.while_loop; all shapes are static (F_MAX x W), so
-one compile serves all histories of a bucketed length. Overflow (frontier
-beyond F_MAX) or window overflow (> W concurrent undecided ops) returns
-UNKNOWN and the caller falls back to the CPU oracle
-(checkers/linearizable.py) — the TPU fast path never *wrongly* answers.
-
-Histories containing :info (indefinite) ops currently take the CPU path:
-an info op may linearize at any point or never, which breaks the
-forced-prefix invariant. (Planned: separate persistent info-bit words.)
+The wave loop is a lax.while_loop; all shapes are static (F_MAX x (W+I)),
+so one compile serves all histories of a bucketed length. On frontier
+overflow the kernel freezes the pre-expansion frontier and returns it;
+the host driver resumes with a chunked BFS (spill mode) using the same
+single-wave expand kernel at full output capacity, so no successor is
+ever lost — the TPU path stays *sound and complete* far past F_MAX, and
+falls back to the CPU oracle only past an explicit state budget.
 """
 
 from __future__ import annotations
@@ -40,7 +47,9 @@ import numpy as np
 from ..checkers.linearizable import Entry, history_entries
 
 W = 32          # window width (max undecided concurrent required ops)
-F_MAX = 512     # frontier capacity per wave
+I_MAX = 32      # info-op capacity (one uint32 mask word)
+F_MAX = 512     # frontier capacity per wave (in-kernel mode)
+SENTINEL_D = np.int32(2 ** 31 - 1)
 SENTINEL_W = np.uint32(0xFFFFFFFF)
 SENTINEL_V = np.int32(2 ** 31 - 1)
 
@@ -48,6 +57,13 @@ READ, WRITE, CAS = 0, 1, 2
 NO_ASSERT = -(2 ** 30)  # distinct from any real (possibly corrupted) version
 NONE_VAL = 0     # value id for "key unset"
 WILDCARD = -1    # read asserted nothing
+
+# spill-mode limits: chunk size per expand launch, frontier cap (a
+# frontier growing past this is combinatorial blowup — BFS cannot win;
+# hand the history to the CPU DFS oracle), and total-state budget
+SPILL_CHUNK = 4096
+SPILL_FRONTIER_LIMIT = 400_000
+SPILL_STATE_BUDGET = 3_000_000
 
 
 @dataclass
@@ -57,8 +73,9 @@ class Packed:
     ok: bool
     reason: str = ""
     R: int = 0
+    I: int = 0
     n_values: int = 0
-    # all [R, W] unless noted
+    # required tables: [R, W] unless noted
     shift: Any = None         # [R] int32
     static_ok: Any = None     # [R, W] bool
     f_code: Any = None        # [R, W] int8
@@ -68,19 +85,25 @@ class Packed:
     pred_frame: Any = None    # [R, W] uint32
     upd_mask: Any = None      # [R] uint32
     u_forced: Any = None      # [R] int32
+    # info tables
+    i_f: Any = None           # [I] int8 (WRITE or CAS)
+    i_a1: Any = None          # [I] int32 (write val / cas old)
+    i_a2: Any = None          # [I] int32 (cas new)
+    i_class_pred: Any = None  # [I] uint32 (same-class ops that must fire 1st)
+    i_static_ok: Any = None   # [R, I] bool (all preds within forced+window)
+    ipred_frame: Any = None   # [R, I] uint32 (window bits that must be set)
 
 
 def pack_register_history(history, value_ids: Optional[dict] = None,
-                          w: int = W) -> Packed:
+                          w: int = W, i_max: int = I_MAX) -> Packed:
     """Build the per-depth tables for the kernel. Returns ok=False with a
     reason when the history needs the CPU path."""
     entries = history_entries(history)
-    infos = [e for e in entries if not e.required]
-    if infos:
-        return Packed(ok=False, reason=f"{len(infos)} info ops (CPU path)")
     req = sorted([e for e in entries if e.required], key=lambda e: e.invoke)
     R = len(req)
     if R == 0:
+        # with no required ops every history linearizes trivially (info
+        # ops may simply never have happened)
         return Packed(ok=True, R=0)
 
     # value id mapping: 0 = None (unset); concrete values from 1
@@ -123,7 +146,60 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
         else:
             return Packed(ok=False, reason=f"op f={e.f!r} not supported")
 
+    # --- info (indefinite) ops: may linearize any time after their
+    # required predecessors, or never. Reads are droppable (invoke value
+    # asserts nothing, model unchanged); so are ops whose invoke follows
+    # every required return (they could only linearize after d == R).
     sorted_ret = np.sort(ret)
+    infos = []
+    for e in entries:
+        if e.required or e.f == "read":
+            continue
+        npred = int(np.searchsorted(sorted_ret, e.invoke, side="left"))
+        if npred >= R:
+            continue
+        infos.append((e, npred))
+    I = len(infos)
+    if I > min(i_max, I_MAX):
+        return Packed(ok=False,
+                      reason=f"{I} info updates > imask capacity {I_MAX}")
+    i_f = np.zeros(I, dtype=np.int8)
+    i_a1 = np.zeros(I, dtype=np.int32)
+    i_a2 = np.zeros(I, dtype=np.int32)
+    i_inv = np.zeros(I, dtype=np.int64)
+    i_npred = np.zeros(I, dtype=np.int64)
+    for j, (e, npred) in enumerate(infos):
+        i_inv[j] = e.invoke
+        i_npred[j] = npred
+        val = e.value if e.value is not None else (None, None)
+        if e.f == "write":
+            i_f[j] = WRITE
+            i_a1[j] = val_id(val[1])
+        elif e.f == "cas" and isinstance(val[1], (list, tuple)) \
+                and len(val[1]) == 2:
+            i_f[j] = CAS
+            old, new = val[1]
+            i_a1[j] = val_id(old)
+            i_a2[j] = val_id(new)
+        else:
+            return Packed(ok=False, reason=f"info op f={e.f!r} not supported")
+    # symmetry reduction: info ops with identical (f, a1, a2) are
+    # interchangeable, and a lower-npred member is enabled whenever a
+    # higher-npred one is, so any linearization can be rewritten to fire
+    # each class in (npred, invoke) order. Restricting the search to that
+    # canonical order collapses 2^I info subsets to per-class prefix
+    # counts without losing any verdict.
+    i_class_pred = np.zeros(I, dtype=np.uint32)
+    for j in range(I):
+        m = np.uint32(0)
+        for k in range(I):
+            if k == j or (i_f[k], i_a1[k], i_a2[k]) != \
+                    (i_f[j], i_a1[j], i_a2[j]):
+                continue
+            if (i_npred[k], i_inv[k], k) < (i_npred[j], i_inv[j], j):
+                m |= np.uint32(1) << np.uint32(k)
+        i_class_pred[j] = m
+
     pred = np.searchsorted(sorted_ret, inv, side="left")  # ret[j] < inv[i]
     cap = np.searchsorted(inv, ret, side="left") - 1      # inv[j] < ret[i], j != i
 
@@ -153,7 +229,7 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
     ret_frame = ret[idx]                                      # [R, W]
     inv_cand = inv[idx]                                       # [R, W]
     is_pred = (ret_frame[:, None, :] < inv_cand[:, :, None])  # [R, W, W]
-    in_range_c = ((lo[:R][:, None] + b_idx) < R)[:, None, :]  # [R, 1, W]
+    in_range_c = in_range[:, None, :]                         # [R, 1, W]
     bits = (1 << np.arange(w, dtype=np.uint64))
     pred_frame = ((is_pred & in_range_c) * bits).sum(-1).astype(np.uint32)
 
@@ -163,13 +239,33 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
     cum_upd = np.concatenate([[0], np.cumsum(is_upd)])
     u_forced = cum_upd[lo[:R]].astype(np.int32)
 
+    # info predecessor tables: info j enabled at depth d iff every
+    # required op with ret < inv_j is linearized — ranks < lo[d] are
+    # forced; ranks in [lo[d], lo[d]+W) must have their window bit set;
+    # any pred rank >= lo[d]+W cannot be linearized yet -> disabled.
+    if I:
+        pred_in_win = in_range[:, :, None] & \
+            (ret_frame[:, :, None] < i_inv[None, None, :])    # [R, W, I]
+        ipred_frame = (pred_in_win * bits[None, :, None]).sum(1) \
+            .astype(np.uint32)                                # [R, I]
+        pf = (ret[:, None] < i_inv[None, :])                  # [R, I]
+        C = np.concatenate([np.zeros((1, I), dtype=np.int64),
+                            np.cumsum(pf, axis=0)])           # [R+1, I]
+        hi = np.minimum(lo[:R] + w, R)                        # [R]
+        i_static_ok = C[hi] == C[R][None, :]                  # [R, I]
+    else:
+        ipred_frame = np.zeros((R, 0), dtype=np.uint32)
+        i_static_ok = np.zeros((R, 0), dtype=bool)
+
     return Packed(
-        ok=True, R=R, n_values=len(vid) + 1,
+        ok=True, R=R, I=I, n_values=len(vid) + 1,
         shift=(lo[1:] - lo[:-1]).astype(np.int32),
         static_ok=static_ok,
         f_code=f[idx].astype(np.int8),
         a1=a1[idx], a2=a2[idx], ver=ver[idx],
         pred_frame=pred_frame, upd_mask=upd_mask, u_forced=u_forced,
+        i_f=i_f, i_a1=i_a1, i_a2=i_a2, i_class_pred=i_class_pred,
+        i_static_ok=i_static_ok, ipred_frame=ipred_frame,
     )
 
 
@@ -177,119 +273,184 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
 # the kernel
 
 
-@functools.lru_cache(maxsize=None)
-def _kernel_jitted(f_max: int, w: int):
-    import jax
-    return jax.jit(functools.partial(_wgl_kernel, f_max=f_max, w=w))
+def _expand(dvec, wvec, ivec, vvec, tables, R, I,
+            w: int, i_pad: int, f_out: int):
+    """One BFS wave: expand a frontier into its deduped successor set.
 
-
-def _wgl_kernel(tables: dict, R, f_max: int = F_MAX, w: int = W):
-    """Run the wave loop. tables hold the [R_pad, W] arrays; R is the
-    dynamic number of waves. Returns (valid, overflow, waves_done,
-    frontier_size_max)."""
-    import jax
+    Pure jax; works standalone (spill mode) and inside the while_loop.
+    Returns (out_d, out_w, out_i, out_v, n_new, accepted). accepted is
+    computed on the *full* candidate set before truncation, so a reached
+    goal is never lost to overflow.
+    """
     import jax.numpy as jnp
     from jax import lax
 
-    shift = tables["shift"]
-    static_ok = tables["static_ok"]
-    f_code = tables["f_code"]
-    a1 = tables["a1"]
-    a2 = tables["a2"]
-    ver = tables["ver"]
-    pred_frame = tables["pred_frame"]
-    upd_mask = tables["upd_mask"]
-    u_forced = tables["u_forced"]
-
+    f_in = dvec.shape[0]
     bpos = jnp.arange(w, dtype=jnp.uint32)[None, :]        # [1, W]
     bit = (jnp.uint32(1) << bpos)
+    alive = (dvec != SENTINEL_D) & (dvec < R)              # [F]
+    d_cl = jnp.clip(dvec, 0, tables["shift"].shape[0] - 1)
+    row = lambda t: jnp.take(t, d_cl, axis=0)              # [F, ...]
+
+    s_ok = row(tables["static_ok"])                        # [F, W]
+    fc = row(tables["f_code"])
+    ra1 = row(tables["a1"])
+    ra2 = row(tables["a2"])
+    rver = row(tables["ver"])
+    rpred = row(tables["pred_frame"])
+    rupd = row(tables["upd_mask"])                         # [F]
+    ruf = row(tables["u_forced"])                          # [F]
+    rshift = row(tables["shift"]).astype(jnp.uint32)       # [F]
+
+    wm = wvec[:, None]                                     # [F, 1]
+    not_set = ((wm >> bpos) & 1) == 0
+    preds_in = (wm & rpred) == rpred
+    version = (ruf + lax.population_count(wvec & rupd).astype(jnp.int32)
+               + lax.population_count(ivec).astype(jnp.int32))  # [F]
+    ver_b = version[:, None]
+    v = vvec[:, None]                                      # [F, 1]
+
+    is_read = fc == READ
+    is_write = fc == WRITE
+    is_cas = fc == CAS
+    no_assert = rver == NO_ASSERT
+    ver_ok = jnp.where(is_read,
+                       no_assert | (rver == ver_b),
+                       no_assert | (rver == ver_b + 1))
+    read_ok = is_read & ((ra1 == WILDCARD) | (ra1 == v))
+    cas_ok = is_cas & (ra1 == v)
+    model_ok = read_ok | is_write | cas_ok
+    req_valid = alive[:, None] & s_ok & not_set & preds_in & ver_ok & model_ok
+
+    new_w = wm | bit                                       # [F, W]
+    # shift may equal w (whole window forced at once); uint32 << 32
+    # is implementation-defined, so saturate explicitly
+    rshift_b = rshift[:, None]
+    full_slide = rshift_b >= jnp.uint32(w)
+    low_mask = jnp.where(full_slide, jnp.uint32(0xFFFFFFFF),
+                         (jnp.uint32(1) << rshift_b) - jnp.uint32(1))
+    slide_ok = (new_w & low_mask) == low_mask
+    req_valid = req_valid & slide_ok
+    new_w = jnp.where(full_slide, jnp.uint32(0), new_w >> rshift_b)
+    req_d = jnp.broadcast_to(dvec[:, None] + 1, (f_in, w))
+    req_i = jnp.broadcast_to(ivec[:, None], (f_in, w))
+    req_v = jnp.where(is_read, v,
+                      jnp.where(is_write, ra1, ra2)).astype(jnp.int32)
+    accepted = jnp.any(req_valid & (req_d == R))
+
+    cand_d = [jnp.where(req_valid, req_d, SENTINEL_D)]
+    cand_w = [jnp.where(req_valid, new_w, jnp.uint32(SENTINEL_W))]
+    cand_i = [req_i]
+    cand_v = [jnp.where(req_valid, req_v, SENTINEL_V)]
+
+    if i_pad:
+        iarange = jnp.arange(i_pad, dtype=jnp.uint32)[None, :]  # [1, I]
+        in_i = iarange < jnp.uint32(I)
+        im = ivec[:, None]
+        ibit_clear = ((im >> iarange) & 1) == 0
+        istat = row(tables["i_static_ok"])                 # [F, I]
+        ipredf = row(tables["ipred_frame"])                # [F, I]
+        ipred_in = (wm & ipredf) == ipredf
+        ifc = tables["i_f"][None, :]
+        ia1 = tables["i_a1"][None, :]
+        ia2 = tables["i_a2"][None, :]
+        i_is_w = ifc == WRITE
+        i_model_ok = i_is_w | ((ifc == CAS) & (ia1 == v))
+        icp = tables["i_class_pred"][None, :]
+        class_ok = (im & icp) == icp
+        i_valid = (alive[:, None] & in_i & ibit_clear & istat & ipred_in
+                   & i_model_ok & class_ok)
+        i_new_i = im | (jnp.uint32(1) << iarange)
+        i_new_v = jnp.where(i_is_w, ia1, ia2).astype(jnp.int32)
+        i_new_v = jnp.broadcast_to(i_new_v, (f_in, i_pad))
+        cand_d.append(jnp.where(i_valid, jnp.broadcast_to(
+            dvec[:, None], (f_in, i_pad)), SENTINEL_D))
+        cand_w.append(jnp.where(i_valid, jnp.broadcast_to(
+            wvec[:, None], (f_in, i_pad)), jnp.uint32(SENTINEL_W)))
+        cand_i.append(i_new_i)
+        cand_v.append(jnp.where(i_valid, i_new_v, SENTINEL_V))
+
+    flat_d = jnp.concatenate(cand_d, axis=1).reshape(-1)
+    flat_w = jnp.concatenate(cand_w, axis=1).reshape(-1)
+    flat_i = jnp.concatenate(cand_i, axis=1).reshape(-1)
+    flat_v = jnp.concatenate(cand_v, axis=1).reshape(-1)
+
+    sd, sw, si, sv = lax.sort((flat_d, flat_w, flat_i, flat_v), num_keys=4)
+    is_real = sd != SENTINEL_D
+    first = jnp.concatenate([
+        jnp.array([True]),
+        (sd[1:] != sd[:-1]) | (sw[1:] != sw[:-1])
+        | (si[1:] != si[:-1]) | (sv[1:] != sv[:-1])])
+    uniq = is_real & first
+    pos = jnp.cumsum(uniq.astype(jnp.int32)) - 1
+    n_new = jnp.sum(uniq.astype(jnp.int32))
+    pos = jnp.where(uniq & (pos < f_out), pos, f_out)      # drop overflowed
+    out_d = jnp.full((f_out + 1,), SENTINEL_D, dtype=jnp.int32)
+    out_w = jnp.full((f_out + 1,), SENTINEL_W, dtype=jnp.uint32)
+    out_i = jnp.full((f_out + 1,), jnp.uint32(0), dtype=jnp.uint32)
+    out_v = jnp.full((f_out + 1,), SENTINEL_V, dtype=jnp.int32)
+    out_d = out_d.at[pos].set(sd, mode="drop")[:f_out]
+    out_w = out_w.at[pos].set(sw, mode="drop")[:f_out]
+    out_i = out_i.at[pos].set(si, mode="drop")[:f_out]
+    out_v = out_v.at[pos].set(sv, mode="drop")[:f_out]
+    return out_d, out_w, out_i, out_v, n_new, accepted
+
+
+@functools.lru_cache(maxsize=None)
+def _kernel_jitted(f_max: int, w: int, i_pad: int):
+    import jax
+    return jax.jit(functools.partial(_wgl_kernel, f_max=f_max, w=w,
+                                     i_pad=i_pad))
+
+
+def _wgl_kernel(tables: dict, R, I, f_max: int = F_MAX, w: int = W,
+                i_pad: int = 0):
+    """Run the wave loop. tables hold the [R_pad, ...] arrays; R (number
+    of required ops) and I (number of info ops) are dynamic. Returns
+    (valid, overflow, waves_done, frontier_size_max, frontier) where
+    frontier = (dvec, wvec, ivec, vvec, n_alive) is the pre-expansion
+    frontier at exit — on overflow the host spill driver resumes from it.
+    """
+    import jax.numpy as jnp
+    from jax import lax
 
     def body(carry):
-        d, wmask, val, n_alive, overflow, peak = carry
+        k, dvec, wvec, ivec, vvec, n_alive, overflow, accepted, peak = carry
         # vmap-safety guard: under vmap, while_loop runs until ALL batch
         # elements finish; finished elements must be no-ops.
-        active = (d < R) & (n_alive > 0) & (~overflow)
-        # row d of each table
-        row = lambda t: lax.dynamic_index_in_dim(t, d, 0, keepdims=False)
-        s_ok = row(static_ok)[None, :]                      # [1, W]
-        fc = row(f_code)[None, :]
-        ra1 = row(a1)[None, :]
-        ra2 = row(a2)[None, :]
-        rver = row(ver)[None, :]
-        rpred = row(pred_frame)[None, :]
-        rupd = row(upd_mask)
-        ruf = row(u_forced)
-        rshift = row(shift).astype(jnp.uint32)
-
-        alive = (jnp.arange(f_max) < n_alive)[:, None]      # [F, 1]
-        wm = wmask[:, None]                                 # [F, 1]
-        not_set = ((wm >> bpos) & 1) == 0
-        preds_in = (wm & rpred) == rpred
-        version = ruf + lax.population_count(wm & rupd).astype(jnp.int32)
-        v = val[:, None]                                    # [F, 1]
-
-        is_read = fc == READ
-        is_write = fc == WRITE
-        is_cas = fc == CAS
-        no_assert = rver == NO_ASSERT
-        ver_ok = jnp.where(is_read,
-                           no_assert | (rver == version),
-                           no_assert | (rver == version + 1))
-        read_ok = is_read & ((ra1 == WILDCARD) | (ra1 == v))
-        cas_ok = is_cas & (ra1 == v)
-        model_ok = read_ok | is_write | cas_ok
-        valid = alive & s_ok & not_set & preds_in & ver_ok & model_ok
-
-        new_w = wm | bit                                    # [F, W]
-        # shift may equal w (whole window forced at once); uint32 << 32
-        # is implementation-defined, so saturate explicitly
-        full_slide = rshift >= jnp.uint32(w)
-        low_mask = jnp.where(full_slide, jnp.uint32(0xFFFFFFFF),
-                             (jnp.uint32(1) << rshift) - jnp.uint32(1))
-        slide_ok = (new_w & low_mask) == low_mask
-        valid = valid & slide_ok
-        new_w = jnp.where(full_slide, jnp.uint32(0), new_w >> rshift)
-        new_v = jnp.where(is_read, v,
-                          jnp.where(is_write, ra1, ra2)).astype(jnp.int32)
-
-        # dedup: sort flattened (w, v) with sentinels for invalid slots
-        flat_w = jnp.where(valid, new_w, jnp.uint32(SENTINEL_W)).reshape(-1)
-        flat_v = jnp.where(valid, new_v, SENTINEL_V).reshape(-1)
-        sw, sv = lax.sort((flat_w, flat_v), num_keys=2)
-        is_real = sw != jnp.uint32(SENTINEL_W)
-        first = jnp.concatenate([
-            jnp.array([True]),
-            (sw[1:] != sw[:-1]) | (sv[1:] != sv[:-1])])
-        uniq = is_real & first
-        pos = jnp.cumsum(uniq.astype(jnp.int32)) - 1
-        n_new = jnp.sum(uniq.astype(jnp.int32))
-        pos = jnp.where(uniq & (pos < f_max), pos, f_max)   # drop overflowed
-        out_w = jnp.full((f_max + 1,), SENTINEL_W, dtype=jnp.uint32)
-        out_v = jnp.full((f_max + 1,), SENTINEL_V, dtype=jnp.int32)
-        out_w = out_w.at[pos].set(sw, mode="drop")
-        out_v = out_v.at[pos].set(sv, mode="drop")
-        out_w = out_w[:f_max]
-        out_v = out_v[:f_max]
-        return (jnp.where(active, d + 1, d),
-                jnp.where(active, out_w, wmask),
-                jnp.where(active, out_v, val),
-                jnp.where(active, jnp.minimum(n_new, f_max), n_alive),
-                jnp.where(active, overflow | (n_new > f_max), overflow),
+        active = (~accepted) & (n_alive > 0) & (~overflow) & (k < R + I + 1)
+        out_d, out_w, out_i, out_v, n_new, acc_now = _expand(
+            dvec, wvec, ivec, vvec, tables, R, I, w, i_pad, f_max)
+        ovf_now = (n_new > f_max) & (~acc_now)
+        # on overflow, freeze the pre-expansion frontier for spill resume
+        advance = active & (~ovf_now)
+        return (jnp.where(advance, k + 1, k),
+                jnp.where(advance, out_d, dvec),
+                jnp.where(advance, out_w, wvec),
+                jnp.where(advance, out_i, ivec),
+                jnp.where(advance, out_v, vvec),
+                jnp.where(advance, jnp.minimum(n_new, f_max), n_alive),
+                jnp.where(active, overflow | ovf_now, overflow),
+                jnp.where(active, accepted | acc_now, accepted),
                 jnp.where(active, jnp.maximum(peak, n_new), peak))
 
     def cond(carry):
-        d, _, _, n_alive, overflow, _ = carry
-        return (d < R) & (n_alive > 0) & (~overflow)
+        k, _, _, _, _, n_alive, overflow, accepted, _ = carry
+        return (~accepted) & (n_alive > 0) & (~overflow) & (k < R + I + 1)
 
+    d0 = jnp.full((f_max,), SENTINEL_D, dtype=jnp.int32)
+    d0 = d0.at[0].set(0)
     w0 = jnp.full((f_max,), SENTINEL_W, dtype=jnp.uint32)
     w0 = w0.at[0].set(0)
+    i0 = jnp.zeros((f_max,), dtype=jnp.uint32)
     v0 = jnp.full((f_max,), SENTINEL_V, dtype=jnp.int32)
     v0 = v0.at[0].set(NONE_VAL)
-    init = (jnp.int32(0), w0, v0, jnp.int32(1), jnp.bool_(False),
-            jnp.int32(1))
-    d, _, _, n_alive, overflow, peak = lax.while_loop(cond, body, init)
-    valid = (d >= R) & (n_alive > 0) & (~overflow)
-    return valid, overflow, d, peak
+    init = (jnp.int32(0), d0, w0, i0, v0, jnp.int32(1), jnp.bool_(False),
+            R == 0, jnp.int32(1))
+    k, dvec, wvec, ivec, vvec, n_alive, overflow, accepted, peak = \
+        lax.while_loop(cond, body, init)
+    return (accepted, overflow, k, peak,
+            (dvec, wvec, ivec, vvec, n_alive))
 
 
 def bucket(n: int) -> int:
@@ -300,27 +461,157 @@ def bucket(n: int) -> int:
     return b
 
 
-def pad_tables(p: Packed, r_pad: int):
-    """Pad the per-depth tables to a bucketed length (shared by
+def bucket_i(n: int) -> int:
+    """Info-op bucket: 0 keeps clean histories on the info-free compile."""
+    if n == 0:
+        return 0
+    b = 8
+    while b < n:
+        b *= 2
+    return min(b, I_MAX)
+
+
+def pad_tables(p: Packed, r_pad: int, i_pad: int = None):
+    """Pad the per-depth tables to bucketed lengths (shared by
     check_packed and the __graft_entry__ paths)."""
-    def padded(a, fill=0):
-        out = np.full((r_pad,) + a.shape[1:], fill, dtype=a.dtype)
-        out[:p.R] = a
+    if i_pad is None:
+        i_pad = bucket_i(p.I)
+
+    def padded(a, rows=r_pad):
+        out = np.zeros((rows,) + a.shape[1:], dtype=a.dtype)
+        out[:a.shape[0]] = a
         return out
 
-    return {
+    def padded_i(a):
+        out = np.zeros((i_pad,), dtype=a.dtype)
+        out[:p.I] = a
+        return out
+
+    def padded_ri(a):
+        out = np.zeros((r_pad, i_pad), dtype=a.dtype)
+        out[:a.shape[0], :p.I] = a
+        return out
+
+    t = {
         "shift": padded(p.shift), "static_ok": padded(p.static_ok),
         "f_code": padded(p.f_code), "a1": padded(p.a1), "a2": padded(p.a2),
         "ver": padded(p.ver), "pred_frame": padded(p.pred_frame),
         "upd_mask": padded(p.upd_mask), "u_forced": padded(p.u_forced),
     }
+    if i_pad:
+        t.update({
+            "i_f": padded_i(p.i_f), "i_a1": padded_i(p.i_a1),
+            "i_a2": padded_i(p.i_a2),
+            "i_class_pred": padded_i(p.i_class_pred),
+            "i_static_ok": padded_ri(p.i_static_ok),
+            "ipred_frame": padded_ri(p.ipred_frame),
+        })
+    return t
+
+
+@functools.lru_cache(maxsize=None)
+def _expand_jitted(f_in: int, w: int, i_pad: int, f_out: int):
+    import jax
+
+    def run(dvec, wvec, ivec, vvec, tables, R, I):
+        return _expand(dvec, wvec, ivec, vvec, tables, R, I, w, i_pad, f_out)
+
+    return jax.jit(run)
+
+
+def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
+               state_budget: int = SPILL_STATE_BUDGET) -> dict:
+    """Host-driven chunked BFS after in-kernel frontier overflow.
+
+    The frontier lives on host as numpy arrays; each wave expands it in
+    SPILL_CHUNK-sized chunks through the single-wave expand kernel at
+    full output capacity (SPILL_CHUNK * (W + i_pad) slots can hold every
+    possible successor of a chunk, so nothing is dropped), then merges
+    across chunks with np.unique. Sound *and* complete: the only exit
+    without a verdict is the explicit state budget.
+
+    This is the "capacity-overflow spill logic" SURVEY §7 names as a hard
+    part; the reference's Knossos equivalent is its unbounded JVM heap
+    (project.clj:21-23 sizes it at 24 GB).
+    """
+    import jax.numpy as jnp
+
+    i_pad = bucket_i(p.I)
+    f_in = SPILL_CHUNK
+    f_out = f_in * (W + max(i_pad, 1))
+    expand = _expand_jitted(f_in, W, i_pad, f_out)
+    dvec, wvec, ivec, vvec, n_alive = [np.asarray(x) for x in frontier]
+    n = int(n_alive)
+    fr = np.stack([dvec[:n].astype(np.int64),
+                   wvec[:n].astype(np.int64),
+                   ivec[:n].astype(np.int64),
+                   vvec[:n].astype(np.int64)], axis=1)
+    states_total = n
+    peak = n
+    waves = waves_done
+    max_waves = p.R + p.I + 1
+    while fr.shape[0] and waves < max_waves:
+        succs = []
+        for s in range(0, fr.shape[0], f_in):
+            chunk = fr[s:s + f_in]
+            cn = chunk.shape[0]
+            cd = np.full(f_in, SENTINEL_D, dtype=np.int32)
+            cw = np.full(f_in, SENTINEL_W, dtype=np.uint32)
+            ci = np.zeros(f_in, dtype=np.uint32)
+            cv = np.full(f_in, SENTINEL_V, dtype=np.int32)
+            cd[:cn] = chunk[:, 0]
+            cw[:cn] = chunk[:, 1].astype(np.uint32)
+            ci[:cn] = chunk[:, 2].astype(np.uint32)
+            cv[:cn] = chunk[:, 3]
+            out_d, out_w, out_i, out_v, n_new, accepted = expand(
+                jnp.asarray(cd), jnp.asarray(cw), jnp.asarray(ci),
+                jnp.asarray(cv), tables, jnp.int32(p.R), jnp.int32(p.I))
+            if bool(accepted):
+                return {"valid?": True, "waves": waves + 1,
+                        "peak-frontier": peak, "ops": p.R,
+                        "info-ops": p.I, "spilled": True,
+                        "states": states_total}
+            m = int(n_new)
+            if m:
+                succs.append(np.stack(
+                    [np.asarray(out_d)[:m].astype(np.int64),
+                     np.asarray(out_w)[:m].astype(np.int64),
+                     np.asarray(out_i)[:m].astype(np.int64),
+                     np.asarray(out_v)[:m].astype(np.int64)], axis=1))
+        if not succs:
+            fr = np.zeros((0, 4), dtype=np.int64)
+            break
+        fr = np.unique(np.concatenate(succs, axis=0), axis=0)
+        waves += 1
+        states_total += fr.shape[0]
+        peak = max(peak, fr.shape[0])
+        if fr.shape[0] > SPILL_FRONTIER_LIMIT:
+            return {"valid?": "unknown",
+                    "reason": f"spill frontier {fr.shape[0]} > "
+                              f"{SPILL_FRONTIER_LIMIT} (blowup; CPU DFS "
+                              f"is the right tool)",
+                    "peak-frontier": peak, "spilled": True}
+        if states_total > state_budget:
+            return {"valid?": "unknown",
+                    "reason": f"spill budget exceeded ({states_total} states)",
+                    "peak-frontier": peak, "spilled": True}
+    if fr.shape[0]:
+        # wave-budget backstop tripped with work remaining: cannot happen
+        # for a well-formed pack (levels are bounded by R+I), so answer
+        # soundly rather than guess
+        return {"valid?": "unknown", "reason": "spill wave budget exceeded",
+                "peak-frontier": peak, "spilled": True}
+    return {"valid?": False, "waves": waves, "peak-frontier": peak,
+            "ops": p.R, "info-ops": p.I, "spilled": True,
+            "states": states_total, "stuck-at-depth": waves}
 
 
 def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
     """Run the kernel on one packed history (host->device->host).
 
     f_max defaults small for short histories (tiny sorts, fast waves) —
-    an overflow retries at full capacity before falling back to CPU.
+    an overflow retries at full capacity, then spills to the host-driven
+    chunked BFS rather than giving up.
     """
     import jax.numpy as jnp
 
@@ -332,17 +623,18 @@ def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
         # frontiers are tiny on healthy histories (peak ~tens); start
         # small — sorts are 4x cheaper — and retry at F_MAX on overflow
         f_max = 128
+    i_pad = bucket_i(p.I)
     tables = {k: jnp.asarray(v)
-              for k, v in pad_tables(p, bucket(p.R)).items()}
-    valid, overflow, d, peak = _kernel_jitted(f_max, W)(
-        tables, jnp.int32(p.R))
+              for k, v in pad_tables(p, bucket(p.R), i_pad).items()}
+    valid, overflow, k, peak, frontier = _kernel_jitted(f_max, W, i_pad)(
+        tables, jnp.int32(p.R), jnp.int32(p.I))
     valid = bool(valid)
     overflow = bool(overflow)
     if overflow and f_max < F_MAX:
         return check_packed(p, f_max=F_MAX)  # retry at full capacity
     if overflow:
-        return {"valid?": "unknown", "reason": "frontier overflow",
-                "peak-frontier": int(peak)}
-    return {"valid?": valid, "waves": int(d), "peak-frontier": int(peak),
-            "ops": p.R,
-            **({} if valid else {"stuck-at-depth": int(d)})}
+        out = _spill_bfs(p, tables, frontier, int(k))
+        return out
+    return {"valid?": valid, "waves": int(k), "peak-frontier": int(peak),
+            "ops": p.R, "info-ops": p.I,
+            **({} if valid else {"stuck-at-depth": int(k)})}

@@ -35,6 +35,14 @@ def test_register_under_kill(tmp_path):
     assert out["results"]["workload"]["valid?"] is True, \
         "kill faults must not break linearizability"
     assert {"kill", "start"} & nemesis_fs(out["history"])
+    # faulted histories (info ops from timeouts/kills) must STAY on the
+    # TPU path — the kernel's info-op support, not the CPU oracle
+    per_key = out["results"]["workload"]["results"]
+    checkers = [r["linear"].get("checker") for r in per_key.values()]
+    assert checkers and all(c == "tpu-wgl" for c in checkers), checkers
+    assert any(r["linear"].get("info-ops", 0) > 0
+               for r in per_key.values()), \
+        "kill run should produce at least one indefinite op"
 
 
 def test_register_under_partition(tmp_path):

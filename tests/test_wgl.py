@@ -18,11 +18,14 @@ from jepsen_etcd_tpu.ops import wgl
 
 
 def gen_history(rng: random.Random, n_procs=4, n_ops=20, values=3,
-                corrupt=False):
+                corrupt=False, info_rate=0.0):
     """Random concurrent register history via linearization-point
     simulation: ops apply atomically at a random instant inside their
     [invoke, complete] span, so the generated history is linearizable by
-    construction — unless `corrupt` flips some observations."""
+    construction — unless `corrupt` flips some observations. With
+    info_rate > 0, some ops complete :info (timeout/crash): the client
+    doesn't learn the outcome — the op took effect with probability 1/2
+    (at its linearization point) or not at all."""
     events = []  # (time, kind, proc, ...)
     t = 0.0
     state_v = 0   # version
@@ -35,12 +38,24 @@ def gen_history(rng: random.Random, n_procs=4, n_ops=20, values=3,
             dur = 0.1 + rng.random()
             spans.append((at, at + dur, p))
             at += dur + rng.random() * 0.3
+    is_info = [rng.random() < info_rate for _ in spans]
+    took_effect = [rng.random() < 0.5 for _ in spans]
     # linearization points decide outcomes
     pts = sorted((rng.uniform(s, e), i) for i, (s, e, p) in enumerate(spans))
     outcomes = {}
     for _, i in pts:
         s, e, p = spans[i]
         f = rng.choice(["read", "write", "cas"])
+        if is_info[i] and not took_effect[i]:
+            # crashed before reaching the server: no state change
+            if f == "read":
+                outcomes[i] = ("read", [None, None])
+            elif f == "write":
+                outcomes[i] = ("write", [None, rng.randrange(values)])
+            else:
+                outcomes[i] = ("cas", [None, [rng.randrange(values),
+                                              rng.randrange(values)]])
+            continue
         if f == "read":
             outcomes[i] = ("read", [state_v, state_val])
         elif f == "write":
@@ -55,6 +70,9 @@ def gen_history(rng: random.Random, n_procs=4, n_ops=20, values=3,
                 state_v += 1
                 state_val = new
                 outcomes[i] = ("cas", [state_v, [old, new]])
+            elif is_info[i]:
+                # would not have matched; still indefinite to the client
+                outcomes[i] = ("cas", [None, [old, new]])
             else:
                 outcomes[i] = ("cas-fail", [None, [old, new]])
     ops = []
@@ -71,7 +89,11 @@ def gen_history(rng: random.Random, n_procs=4, n_ops=20, values=3,
                           value=[None, val[1]] if fv != "read"
                           else [None, None]))
         else:
-            if f == "cas-fail":
+            if is_info[i]:
+                ops.append(Op(type="info", process=p, f=f,
+                              value=[None, val[1]] if f != "read"
+                              else [None, None], error="timeout"))
+            elif f == "cas-fail":
                 ops.append(Op(type="fail", process=p, f="cas",
                               value=[None, val[1]], error="did-not-succeed"))
             else:
@@ -129,15 +151,128 @@ def test_kernel_packing_feasibility():
     assert p.shift.sum() == p.R
 
 
-def test_info_ops_fall_back():
+def test_info_only_history_is_trivially_valid():
     h = History([
         Op(type="invoke", process=0, f="write", value=[None, 1]),
         Op(type="info", process=0, f="write", value=[None, 1]),
     ])
     p = wgl.pack_register_history(h)
-    assert not p.ok and "info" in p.reason
+    assert p.ok and p.R == 0
     out = TPULinearizableChecker(fallback=True).check({}, h)
-    assert out["valid?"] is True and out["checker"] == "cpu-oracle"
+    assert out["valid?"] is True
+
+
+def test_info_write_may_have_happened():
+    # crashed write of 7; later read sees 7 at version 1 — only legal if
+    # the info write linearized. The kernel must find it.
+    h = History([
+        Op(type="invoke", process=0, f="write", value=[None, 7]),
+        Op(type="info", process=0, f="write", value=[None, 7],
+           error="timeout"),
+        Op(type="invoke", process=1, f="read", value=[None, None]),
+        Op(type="ok", process=1, f="read", value=[1, 7]),
+    ])
+    p = wgl.pack_register_history(h)
+    assert p.ok and p.R == 1 and p.I == 1
+    out = TPULinearizableChecker(fallback=False).check({}, h)
+    assert out["valid?"] is True and out["checker"] == "tpu-wgl"
+
+
+def test_info_write_may_not_have_happened():
+    # crashed write of 7; later read sees version 0 / unset — only legal
+    # if the info write never linearized.
+    h = History([
+        Op(type="invoke", process=0, f="write", value=[None, 7]),
+        Op(type="info", process=0, f="write", value=[None, 7],
+           error="timeout"),
+        Op(type="invoke", process=1, f="read", value=[None, None]),
+        Op(type="ok", process=1, f="read", value=[0, None]),
+    ])
+    out = TPULinearizableChecker(fallback=False).check({}, h)
+    assert out["valid?"] is True and out["checker"] == "tpu-wgl"
+
+
+def test_info_write_cannot_rescue_impossible_read():
+    # read sees version 2 but only one (crashed) write exists: version
+    # can reach at most 1 — invalid, and the kernel must say so.
+    h = History([
+        Op(type="invoke", process=0, f="write", value=[None, 7]),
+        Op(type="info", process=0, f="write", value=[None, 7],
+           error="timeout"),
+        Op(type="invoke", process=1, f="read", value=[None, None]),
+        Op(type="ok", process=1, f="read", value=[2, 7]),
+    ])
+    out = TPULinearizableChecker(fallback=False).check({}, h)
+    assert out["valid?"] is False
+
+
+def test_info_pred_ordering():
+    # an info op invoked AFTER an ok op returns cannot linearize before
+    # it: w=1 completes (version 1), THEN a write of 2 crashes, then a
+    # read sees [1, 2] — impossible: the crashed write could only
+    # linearize at version 2.
+    h = History([
+        Op(type="invoke", process=0, f="write", value=[None, 1]),
+        Op(type="ok", process=0, f="write", value=[1, 1]),
+        Op(type="invoke", process=1, f="write", value=[None, 2]),
+        Op(type="info", process=1, f="write", value=[None, 2],
+           error="timeout"),
+        Op(type="invoke", process=2, f="read", value=[None, None]),
+        Op(type="ok", process=2, f="read", value=[1, 2]),
+    ])
+    cpu = check_history(VersionedRegister(), h)
+    out = TPULinearizableChecker(fallback=False).check({}, h)
+    assert cpu["valid?"] is False
+    assert out["valid?"] is False
+
+
+def test_info_cas_requires_matching_value():
+    # crashed cas(1->9) can only linearize when value is 1; value history
+    # is 2 only, so a read of [2, 9] is impossible...
+    h = History([
+        Op(type="invoke", process=0, f="write", value=[None, 2]),
+        Op(type="ok", process=0, f="write", value=[1, 2]),
+        Op(type="invoke", process=1, f="cas", value=[None, [1, 9]]),
+        Op(type="info", process=1, f="cas", value=[None, [1, 9]],
+           error="timeout"),
+        Op(type="invoke", process=2, f="read", value=[None, None]),
+        Op(type="ok", process=2, f="read", value=[2, 9]),
+    ])
+    out = TPULinearizableChecker(fallback=False).check({}, h)
+    assert out["valid?"] is False
+    # ...but cas(2->9) CAN: value 2 at version 1, cas makes version 2.
+    h2 = History([
+        Op(type="invoke", process=0, f="write", value=[None, 2]),
+        Op(type="ok", process=0, f="write", value=[1, 2]),
+        Op(type="invoke", process=1, f="cas", value=[None, [2, 9]]),
+        Op(type="info", process=1, f="cas", value=[None, [2, 9]],
+           error="timeout"),
+        Op(type="invoke", process=2, f="read", value=[None, None]),
+        Op(type="ok", process=2, f="read", value=[2, 9]),
+    ])
+    out2 = TPULinearizableChecker(fallback=False).check({}, h2)
+    assert out2["valid?"] is True
+
+
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_differential_info_histories(corrupt):
+    # crashed-op histories: the kernel's info path vs the CPU oracle
+    rng = random.Random(4242 if corrupt else 777)
+    checker = TPULinearizableChecker(fallback=False)
+    definitive = 0
+    for trial in range(120):
+        h = gen_history(rng, n_procs=rng.randint(2, 5),
+                        n_ops=rng.randint(8, 28), corrupt=corrupt,
+                        info_rate=0.3)
+        cpu = check_history(VersionedRegister(), h)
+        tpu = checker.check({}, h)
+        if tpu["valid?"] == "unknown" or cpu["valid?"] == "unknown":
+            continue
+        definitive += 1
+        assert tpu["valid?"] == cpu["valid?"], (
+            f"trial {trial}: kernel={tpu} oracle={cpu['valid?']}\n"
+            + h.to_jsonl())
+    assert definitive >= 100, f"only {definitive}/120 definitive"
 
 
 def test_kernel_on_real_run_history(tmp_path):
@@ -193,6 +328,38 @@ def test_full_window_slide():
     assert raw["valid?"] in (True, "unknown")  # never a wrong False
     out = TPULinearizableChecker(fallback=True).check({}, h)
     assert out["valid?"] is True
+
+
+def _concurrent_writes_history(n=16, read_val=1, read_ver=None):
+    # n mutually-concurrent unversioned writes of the same value, then a
+    # sequential read. Peak frontier = C(n, n/2) — far past F_MAX=512 for
+    # n=16 (12870), exercising the spill path end to end.
+    ops = []
+    for p in range(n):
+        ops.append(Op(type="invoke", process=p, f="write", value=[None, 1]))
+    for p in range(n):
+        ops.append(Op(type="ok", process=p, f="write", value=[None, 1]))
+    ops.append(Op(type="invoke", process=n, f="read", value=[None, None]))
+    ops.append(Op(type="ok", process=n, f="read",
+                  value=[n if read_ver is None else read_ver, read_val]))
+    return History(ops)
+
+
+def test_spill_valid_verdict_past_fmax():
+    h = _concurrent_writes_history(16, read_val=1)
+    out = TPULinearizableChecker(fallback=False).check({}, h)
+    assert out["valid?"] is True, out
+    assert out.get("spilled"), out
+    assert out["peak-frontier"] > wgl.F_MAX
+
+
+def test_spill_invalid_verdict_past_fmax():
+    # read observes a value nobody wrote: invalid, proven by exhausting
+    # the spilled search (complete, not just sound)
+    h = _concurrent_writes_history(16, read_val=9)
+    out = TPULinearizableChecker(fallback=False).check({}, h)
+    assert out["valid?"] is False, out
+    assert out.get("spilled"), out
 
 
 def test_non_register_model_goes_to_cpu():
