@@ -199,14 +199,15 @@ class DepGraph:
             return adjs[li]
 
         def anchored(name: str, anchor_edges, need: int,
-                     forbid: Optional[int] = None) -> Optional[dict]:
+                     forbid: tuple = ()) -> Optional[dict]:
             """A cycle = anchor edge (a, b) + back-path b->a in level
-            `need`; with `forbid`, only cycles impossible at the weaker
-            level (i.e. genuinely needing the edges `need` adds)."""
+            `need`; `forbid` lists weaker levels the back-path must NOT
+            exist at (so the cycle genuinely needs the edges `need`
+            adds, and a weaker anomaly is never re-labeled here)."""
             for (a, b) in sorted(anchor_edges):
                 if not reach[need][b, a]:
                     continue
-                if forbid is not None and reach[forbid][b, a]:
+                if any(reach[f][b, a] for f in forbid):
                     continue
                 back = _bfs_path(adj(need), b, a)
                 if back is not None:
@@ -226,17 +227,21 @@ class DepGraph:
         if on_cycle[1].any():
             add(anchored("G1c", wr, need=1))
         if on_cycle[2].any():
-            if not add(anchored("G-single", rw, need=1)):
-                add(anchored("G2-item", rw, need=2))
+            # Scan both classes: a history can contain a G-single AND an
+            # independent G2-item cycle. The forbid gate keeps G2-item
+            # anchored only on rw edges whose back-path genuinely needs
+            # a second rw, so one cycle is never labeled twice.
+            add(anchored("G-single", rw, need=1))
+            add(anchored("G2-item", rw, need=2, forbid=(1,)))
         if len(levels) > 3:
             if on_cycle[3].any():
-                add(anchored("G0-realtime", ww, need=3, forbid=0))
+                add(anchored("G0-realtime", ww, need=3, forbid=(0,)))
             if on_cycle[4].any():
-                add(anchored("G1c-realtime", wr, need=4, forbid=1))
+                add(anchored("G1c-realtime", wr, need=4, forbid=(1,)))
             if on_cycle[5].any():
-                if not add(anchored("G-single-realtime", rw, need=4,
-                                    forbid=1)):
-                    add(anchored("G2-item-realtime", rw, need=5, forbid=2))
+                add(anchored("G-single-realtime", rw, need=4, forbid=(1,)))
+                add(anchored("G2-item-realtime", rw, need=5,
+                             forbid=(2, 4)))
         return recs
 
     def _record(self, name: str, cycle: list) -> dict:

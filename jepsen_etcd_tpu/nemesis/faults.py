@@ -23,7 +23,7 @@ from ..core.op import Op
 from ..client import DirectClient
 from ..generators import (fn_gen, limit, mix, stagger, delay, time_limit,
                           phases, any_gen, seq)
-from ..runner.sim import current_loop, SECOND
+from ..runner.sim import current_loop, sleep, SECOND
 from ..sut.errors import SimError
 from .packages import Nemesis
 
@@ -212,12 +212,27 @@ def clock_package(opts: dict) -> dict:
         return op.evolve(type="info")
 
     async def strobe(test, op):
-        # rapid oscillation approximated as its net effect: a small
-        # residual skew on each strobed node
+        # genuinely oscillate: flip each strobed node's clock between 0
+        # and +delta every period-ms for duration-ms (the sim analog of
+        # jepsen.nemesis.time strobe-time!), so lease-expiry races see a
+        # moving clock, not just a one-shot skew
         cluster = test["cluster"]
-        rng = current_loop().rng
-        for node in (op.value or {}).get("nodes", []):
-            cluster.bump_clock(node, rng.randint(-200, 200) * MS)
+        v = op.value or {}
+        nodes = v.get("nodes", [])
+        period = max(1, int(v.get("period-ms", 1))) * MS
+        duration = int(v.get("duration-ms", 1000)) * MS
+        delta = int(v.get("delta-ms", 200)) * MS
+        loop = current_loop()
+        end = loop.now + duration
+        up = False
+        while loop.now < end:
+            for node in nodes:
+                cluster.bump_clock(node, -delta if up else delta)
+            up = not up
+            await sleep(min(period, end - loop.now))
+        if up:  # land back where we started, residual skew = 0
+            for node in nodes:
+                cluster.bump_clock(node, -delta)
         return op.evolve(type="info")
 
     async def reset(test, op):
@@ -239,7 +254,9 @@ def clock_package(opts: dict) -> dict:
     def gen_strobe(test, ctx):
         return {"f": "strobe-clock",
                 "value": {"nodes": rand_subset(ctx, test),
-                          "period-ms": 2 ** ctx.rng.randint(0, 10)}}
+                          "period-ms": 2 ** ctx.rng.randint(0, 10),
+                          "delta-ms": 2 ** ctx.rng.randint(4, 9),
+                          "duration-ms": ctx.rng.randint(200, 2000)}}
 
     def gen_reset(test, ctx):
         return {"f": "reset-clock", "value": None}

@@ -137,17 +137,20 @@ class WatchClient(WorkloadClient):
         def on_events(events):
             if errors:
                 return
-            vals = [e.kv["value"] if e.kv else None for e in events]
-            rev2 = max(e.revision for e in events)
-            if not state["revision"] < rev2:
-                errors.append(SimError(
-                    "nonmonotonic-watch",
-                    f"got event with revision {rev2} but we last saw "
-                    f"{state['revision']}", definite=True))
-                w.cancel()
-                return
-            state["revision"] = rev2
-            state["log"].extend(vals)
+            # Per-EVENT monotonicity, as the reference checks each event
+            # against the last seen revision (watch.clj:161-177) — an
+            # intra-batch out-of-order or stale event is an error even if
+            # the batch max advances.
+            for e in events:
+                if not state["revision"] < e.revision:
+                    errors.append(SimError(
+                        "nonmonotonic-watch",
+                        f"got event with revision {e.revision} but we "
+                        f"last saw {state['revision']}", definite=True))
+                    w.cancel()
+                    return
+                state["revision"] = e.revision
+                state["log"].append(e.kv["value"] if e.kv else None)
 
         def on_error(e):
             errors.append(e)

@@ -296,3 +296,25 @@ def test_append_workload_e2e(tmp_path):
     out = run(tmp_path, workload="append")
     assert out["valid?"] is True, out["results"]["workload"]["anomaly-types"]
     assert out["results"]["workload"]["txn-count"] > 50
+
+
+def test_gsingle_and_g2item_both_reported():
+    """A history with a G-single cycle AND an independent G2-item cycle
+    reports both (find_cycles must not short-circuit after G-single)."""
+    from jepsen_etcd_tpu.checkers.elle.graph import DepGraph
+
+    g = DepGraph(4)
+    # G-single: 0 -rw-> 1 -wr-> 0  (exactly one rw)
+    g.add("rw", 0, 1)
+    g.add("wr", 1, 0)
+    # independent G2-item: 2 -rw-> 3 -rw-> 2  (two rw)
+    g.add("rw", 2, 3)
+    g.add("rw", 3, 2)
+    recs = g.find_cycles(realtime=False)
+    types = {r["type"] for r in recs}
+    assert "G-single" in types
+    assert "G2-item" in types
+    # and the G2-item certificate is the 2<->3 cycle, not a relabel of
+    # the G-single one
+    g2 = next(r for r in recs if r["type"] == "G2-item")
+    assert set(g2["cycle"]) == {2, 3}
