@@ -39,6 +39,23 @@ class Compose(Checker):
                                         results.values()]),
                 **results}
 
+    def check_batch(self, test, subhistories: dict, opts=None) -> dict:
+        """Per-key batch entry (called by checkers.Independent): children
+        that are batch-aware (the TPU kernel) get the whole key batch in
+        one call; the rest run per key."""
+        per_key: dict = {k: {} for k in subhistories}
+        for name, c in self.checkers.items():
+            if hasattr(c, "check_batch"):
+                outs = c.check_batch(test, subhistories, opts)
+            else:
+                outs = {k: c.check(test, sub, opts)
+                        for k, sub in subhistories.items()}
+            for k, r in outs.items():
+                per_key[k][name] = r
+        return {k: {"valid?": _merge_valid([r.get("valid?")
+                                            for r in rs.values()]), **rs}
+                for k, rs in per_key.items()}
+
 
 def compose(checkers: dict) -> Compose:
     return Compose(checkers)

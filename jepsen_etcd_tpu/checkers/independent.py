@@ -21,10 +21,15 @@ class Independent(Checker):
 
     def check(self, test, history, opts=None) -> dict:
         h = history if isinstance(history, History) else History(history)
-        results = {}
-        for k in history_keys(h):
-            sub = History(subhistory(h, k))
-            results[k] = self.inner.check(test, sub, opts)
+        subs = {k: History(subhistory(h, k)) for k in history_keys(h)}
+        if hasattr(self.inner, "check_batch"):
+            # batch-aware inner checker (TPULinearizableChecker): one
+            # vmapped kernel launch over the whole key batch, sharded
+            # over the device mesh — not a serial per-key loop
+            results = self.inner.check_batch(test, subs, opts)
+        else:
+            results = {k: self.inner.check(test, sub, opts)
+                       for k, sub in subs.items()}
         return {
             "valid?": _merge_valid([r.get("valid?")
                                     for r in results.values()]),

@@ -26,34 +26,30 @@ class TPULinearizableChecker(Checker):
         self.fallback = fallback
         self.f_max = f_max
 
-    def check(self, test, history, opts=None) -> dict:
-        from ..ops import wgl
+    def _kernel_ok(self) -> bool:
         # The kernel implements exactly VersionedRegister(0, None); any
         # other model/initial state must take the CPU path.
-        if self.model_fn() != VersionedRegister(0, None):
-            reason = "model is not VersionedRegister(0, None)"
-            p = None
-        else:
-            p = wgl.pack_register_history(history)
-            reason = None
-        if p is not None and p.ok:
-            out = wgl.check_packed(p, f_max=self.f_max)
-            if out["valid?"] is True:
-                out["checker"] = "tpu-wgl"
-                return out
-            if out["valid?"] is False:
-                # attach the counterexample diagnostics (offending op,
-                # model error) the CPU oracle produces; violations are
-                # rare so the extra search is cheap
-                out["checker"] = "tpu-wgl"
-                cpu = check_history(self.model_fn(), history)
-                for k in ("op", "error", "max-linearized"):
-                    if k in cpu:
-                        out[k] = cpu[k]
-                return out
-            reason = out.get("reason", "unknown")
-        elif p is not None:
-            reason = p.reason
+        return self.model_fn() == VersionedRegister(0, None)
+
+    def _finalize(self, history, out: dict) -> dict:
+        """Post-process one kernel verdict into a checker result,
+        attaching CPU counterexample diagnostics / fallback as needed."""
+        if out["valid?"] is True:
+            out["checker"] = "tpu-wgl"
+            return out
+        if out["valid?"] is False:
+            # attach the counterexample diagnostics (offending op,
+            # model error) the CPU oracle produces; violations are
+            # rare so the extra search is cheap
+            out["checker"] = "tpu-wgl"
+            cpu = check_history(self.model_fn(), history)
+            for k in ("op", "error", "max-linearized"):
+                if k in cpu:
+                    out[k] = cpu[k]
+            return out
+        return self._fallback(history, out.get("reason", "unknown"))
+
+    def _fallback(self, history, reason: str) -> dict:
         if not self.fallback:
             return {"valid?": "unknown", "reason": reason,
                     "checker": "tpu-wgl"}
@@ -62,6 +58,32 @@ class TPULinearizableChecker(Checker):
         out["checker"] = "cpu-oracle"
         out["tpu-fallback-reason"] = reason
         return out
+
+    def check(self, test, history, opts=None) -> dict:
+        from ..ops import wgl
+        if not self._kernel_ok():
+            return self._fallback(
+                history, "model is not VersionedRegister(0, None)")
+        p = wgl.pack_register_history(history)
+        if not p.ok:
+            return self._fallback(history, p.reason)
+        return self._finalize(history, wgl.check_packed(p, f_max=self.f_max))
+
+    def check_batch(self, test, subhistories: dict, opts=None) -> dict:
+        """Check many per-key histories in one vmapped, mesh-sharded
+        kernel launch (the production form of SURVEY §2.3's key-level
+        DP axis). Called by checkers.Independent; falls back per key."""
+        from ..ops import wgl
+        keys = list(subhistories)
+        if not self._kernel_ok():
+            return {k: self.check(test, subhistories[k], opts)
+                    for k in keys}
+        packs = [wgl.pack_register_history(subhistories[k]) for k in keys]
+        outs = wgl.check_packed_batch(packs, f_max=self.f_max)
+        # unpackable keys come back "unknown" with the pack reason;
+        # _finalize routes those through the CPU fallback
+        return {k: self._finalize(subhistories[k], out)
+                for k, out in zip(keys, outs)}
 
 
 def tpu_linearizable(model_fn=None) -> TPULinearizableChecker:

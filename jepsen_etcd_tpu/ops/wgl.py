@@ -606,6 +606,105 @@ def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
             "states": states_total, "stuck-at-depth": waves}
 
 
+@functools.lru_cache(maxsize=None)
+def _batched_kernel_jitted(f_max: int, w: int, i_pad: int):
+    import jax
+    kernel = functools.partial(_wgl_kernel, f_max=f_max, w=w, i_pad=i_pad)
+    return jax.jit(jax.vmap(kernel))
+
+
+def check_packed_batch(packs: list, f_max: Optional[int] = None) -> list:
+    """Check K per-key packed histories in vmapped kernel launches.
+
+    This is the production key-level data-parallel axis (SURVEY §2.3; the
+    per-key decomposition of ``register.clj:108-119``): tables are padded
+    to a shared (K_pad, R_pad, ...) batch, sharded over the device mesh
+    along the key axis when more than one device is present (ICI carries
+    nothing — keys are independent, so the "collective" layout is a pure
+    scatter), and expanded wave-parallel on device. Keys are grouped by
+    (R-bucket, I-bucket) — one launch per group — so a single long-history
+    key neither inflates every key's padded tables nor forces cold keys
+    through its wave count (while_loop under vmap runs until the slowest
+    batch element finishes). Per-key overflow falls out of the batch and
+    retries/spills through ``check_packed``.
+
+    Returns one result dict per pack, aligned with the input order.
+    """
+    results: list = [None] * len(packs)
+    groups: dict = {}
+    for i, p in enumerate(packs):
+        if not p.ok:
+            results[i] = {"valid?": "unknown", "reason": p.reason}
+        elif p.R == 0:
+            results[i] = {"valid?": True, "waves": 0}
+        else:
+            groups.setdefault((bucket(p.R), bucket_i(p.I)), []).append(i)
+    for (r_pad, i_pad), idxs in groups.items():
+        _check_bucket_group(packs, results, idxs, r_pad, i_pad, f_max)
+    return results
+
+
+def _check_bucket_group(packs: list, results: list, idxs: list,
+                        r_pad: int, i_pad: int,
+                        f_max: Optional[int]) -> None:
+    """One vmapped launch for a same-bucket key group; results written
+    in place."""
+    import jax
+    import jax.numpy as jnp
+
+    if len(idxs) == 1:
+        results[idxs[0]] = check_packed(packs[idxs[0]], f_max=f_max)
+        return
+    if f_max is None:
+        f_max = 128
+    K = len(idxs)
+    devs = jax.devices()
+    n_dev = len(devs)
+    k_pad = -(-K // n_dev) * n_dev  # shard the key axis evenly
+    per_key = [pad_tables(packs[i], r_pad, i_pad) for i in idxs]
+    stacked = {}
+    for name in per_key[0]:
+        arrs = [t[name] for t in per_key]
+        out = np.zeros((k_pad,) + arrs[0].shape, dtype=arrs[0].dtype)
+        for j, a in enumerate(arrs):
+            out[j] = a
+        stacked[name] = out
+    Rs = np.zeros(k_pad, dtype=np.int32)  # padding keys: R=0 -> accepted
+    Is = np.zeros(k_pad, dtype=np.int32)
+    for j, i in enumerate(idxs):
+        Rs[j] = packs[i].R
+        Is[j] = packs[i].I
+
+    if n_dev > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(devs), ("dp",))
+
+        def put(x):
+            s = NamedSharding(mesh, P("dp", *([None] * (x.ndim - 1))))
+            return jax.device_put(jnp.asarray(x), s)
+    else:
+        put = jnp.asarray
+    tables_dev = {k: put(v) for k, v in stacked.items()}
+    valid, overflow, waves, peak, _frontier = _batched_kernel_jitted(
+        f_max, W, i_pad)(tables_dev, put(Rs), put(Is))
+    valid = np.asarray(valid)
+    overflow = np.asarray(overflow)
+    waves = np.asarray(waves)
+    peak = np.asarray(peak)
+    for j, i in enumerate(idxs):
+        p = packs[i]
+        if overflow[j]:
+            # retry at full capacity, then spill — per key, off the batch
+            results[i] = check_packed(p, f_max=F_MAX)
+        else:
+            v = bool(valid[j])
+            results[i] = {
+                "valid?": v, "waves": int(waves[j]),
+                "peak-frontier": int(peak[j]), "ops": p.R,
+                "info-ops": p.I, "batched": True,
+                **({} if v else {"stuck-at-depth": int(waves[j])})}
+
+
 def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
     """Run the kernel on one packed history (host->device->host).
 
