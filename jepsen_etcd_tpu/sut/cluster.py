@@ -83,6 +83,10 @@ class Node:
         self.leader_hint: Optional[str] = None
         self.election_deadline = 0
         self.last_quorum_contact = 0
+        # campaign state (message-level elections): votes received for
+        # the current campaign; campaign_id guards stale vote responses
+        self.votes: set = set()
+        self.campaign_id = 0
         # log: entries [log_start..]; index 0 is a sentinel before start
         self.log: list[LogEntry] = []
         self.log_start = 1      # raft index of log[0]
@@ -369,34 +373,78 @@ class Cluster:
     # ---- elections & replication ------------------------------------------
 
     def _start_election(self, cand: Node) -> None:
+        """Campaign via message-delayed RequestVote RPCs.
+
+        Requests and responses travel as separate delayed messages (like
+        ``_send_append``), so split votes, stale candidates, interleaved
+        campaigns, and vote messages lost to partitions/kills all occur —
+        the raft schedule surface the reference gets for free by running
+        real etcd (db.clj:72-100). The RPC carries the candidate's log
+        position captured at send time, per the raft paper.
+        """
         cand.term += 1
         cand.voted_for = cand.name
         cand.role = "candidate"
         cand.reset_election_deadline()
-        votes = 1
+        cand.campaign_id += 1
+        cand.votes = {cand.name}
+        cand.log_line(f"campaigning at term {cand.term}")
+        last_term, last_index = cand.last_term(), cand.last_index()
         for m in cand.membership:
-            if m == cand.name or not self.reachable(cand.name, m):
+            if m == cand.name:
                 continue
-            peer = self.nodes.get(m)
-            if peer is None or peer.removed:
-                continue
-            if peer.term > cand.term:
-                cand.term = peer.term
-                cand.role = "follower"
-                return
-            up_to_date = (cand.last_term(), cand.last_index()) >= \
-                         (peer.last_term(), peer.last_index())
-            if peer.term < cand.term:
-                peer.term = cand.term
+            self.loop.spawn(
+                self._request_vote(cand, m, cand.term, cand.campaign_id,
+                                   last_term, last_index), "vote")
+        if len(cand.votes) >= cand.majority():   # single-node cluster
+            self._become_leader(cand)
+
+    async def _request_vote(self, cand: Node, peer_name: str, term: int,
+                            campaign_id: int, last_term: int,
+                            last_index: int) -> None:
+        # request leg: delivered only if both ends are up and connected
+        # at arrival time (same drop model as _send_append)
+        await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+        peer = self.nodes.get(peer_name)
+        if (peer is None or peer.removed
+                or not self.reachable(cand.name, peer_name)):
+            return
+        granted = False
+        if peer.term <= term:
+            if peer.term < term:
+                peer.term = term
                 peer.voted_for = None
                 if peer.role != "follower":
                     peer.role = "follower"
+                    peer.log_line(f"stepping down: saw term {term}")
+            up_to_date = (last_term, last_index) >= \
+                         (peer.last_term(), peer.last_index())
             if peer.voted_for in (None, cand.name) and up_to_date:
                 peer.voted_for = cand.name
                 peer.reset_election_deadline()
-                votes += 1
-        if votes >= cand.majority():
-            self._become_leader(cand)
+                granted = True
+        resp_term = peer.term
+        # response leg
+        await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+        if not self.reachable(peer_name, cand.name):
+            return
+        if resp_term > cand.term:
+            # may already have won and accepted proposals: fail their
+            # waiters like every other step-down site
+            cand.term = resp_term
+            cand.role = "follower"
+            cand.voted_for = None
+            cand.reset_election_deadline()
+            self._fail_waiters(cand, SimError(
+                "leader-changed", "higher term in vote response"))
+            return
+        if cand.role != "candidate" or cand.campaign_id != campaign_id \
+                or cand.term != term:
+            return  # stale response: a newer campaign, or already decided
+        if granted:
+            cand.votes.add(peer_name)
+            if len(cand.votes) >= cand.majority():
+                self._become_leader(cand)
 
     def _become_leader(self, n: Node) -> None:
         n.role = "leader"
@@ -926,7 +974,8 @@ class Cluster:
         n.paused = False
         n.removed = name not in n.membership
         n.role = "follower"
-        n.voted_for = None
+        if fresh:
+            n.voted_for = None   # non-fresh restarts keep HardState vote
         n.leader_hint = None
         n.waiters = {}
         n.watchers = []
@@ -965,7 +1014,14 @@ class Cluster:
                  if i >= n.log_start]
         n.wal_current = walmod.encode_records(
             [(e.index, e.term, e.kind, e.payload) for e in n.log])
-        n.term = max([n.snap_term] + [e.term for e in n.log])
+        # HardState: etcd persists (term, vote) in its WAL and fsyncs it
+        # before answering RPCs, so a restarted voter can never re-grant
+        # its vote in the same term (raft election safety). We model the
+        # hard state as surviving in the Node object across kill/restart
+        # (n.term / n.voted_for are simply not cleared); the log-derived
+        # term below is only a floor for nodes whose object predates the
+        # campaign.
+        n.term = max([n.term, n.snap_term] + [e.term for e in n.log])
         # conservative: nothing beyond the snapshot is known committed;
         # the leader's replication will re-advance commit_index.
         n.commit_index = n.snap_index

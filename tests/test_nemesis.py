@@ -53,7 +53,10 @@ def test_register_under_partition(tmp_path):
 
 
 def test_register_under_pause_clock(tmp_path):
-    out = run(tmp_path, workload="register", nemesis=["pause", "clock"])
+    # longer window: enough nemesis cycles that both fault classes fire
+    # regardless of where the seed lands the pause/clock mix
+    out = run(tmp_path, workload="register", nemesis=["pause", "clock"],
+              time_limit=40)
     assert out["results"]["workload"]["valid?"] is True
     fs = nemesis_fs(out["history"])
     assert "pause" in fs
