@@ -126,14 +126,67 @@ class LogFilePattern(Checker):
 
 
 class ClockPlot(Checker):
-    """checker/clock-plot: records clock-offset data (artifact-only)."""
+    """checker/clock-plot: renders per-node clock offsets over time to
+    clock.png (like the reference's plot, not data-only), reconstructed
+    from the recorded clock-nemesis ops."""
 
     def check(self, test, history, opts=None) -> dict:
         h = history if isinstance(history, History) else History(history)
-        points = [(op.time, op.value) for op in h.nemesis_ops()
+        points = [(op.time, op.f, op.value) for op in h.nemesis_ops()
                   if op.f in ("bump-clock", "strobe-clock", "reset-clock")
                   and op.is_completion]
-        return {"valid?": True, "points": points[:1000]}
+        result = {"valid?": True,
+                  "points": [(t, v) for t, _, v in points][:1000]}
+        store_dir = (opts or {}).get("store_dir")
+        if store_dir and points:
+            try:
+                self._plot(points, store_dir)
+                result["plots"] = ["clock.png"]
+            except Exception as e:  # plotting must never fail a test run
+                result["plot-error"] = repr(e)
+        return result
+
+    def _plot(self, points, store_dir):
+        import os
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        from collections import defaultdict
+
+        # reconstruct cumulative offset per node from bump/reset events;
+        # strobes render as shaded oscillation windows
+        series = defaultdict(lambda: [(0.0, 0.0)])
+        strobes = []
+        for t, f, v in points:
+            ts = (t or 0) / 1e9
+            if f == "bump-clock" and isinstance(v, dict):
+                for node, delta_ms in v.items():
+                    prev = series[node][-1][1]
+                    series[node].append((ts, prev))
+                    series[node].append((ts, prev + delta_ms))
+            elif f == "reset-clock":
+                for node in list(series) or list(v or []):
+                    prev = series[node][-1][1]
+                    series[node].append((ts, prev))
+                    series[node].append((ts, 0.0))
+            elif f == "strobe-clock" and isinstance(v, dict):
+                # the op completes AFTER oscillating for duration-ms, so
+                # the window it strobed is (completion - duration,
+                # completion)
+                dur = v.get("duration-ms", 0) / 1e3
+                strobes.append((ts - dur, ts, v.get("delta-ms", 0)))
+        fig, ax = plt.subplots(figsize=(10, 3))
+        for lo, hi, delta in strobes:
+            ax.axvspan(lo, hi, alpha=0.2, color="#FFDB9A")
+        for node in sorted(series):
+            xs = [x for x, _ in series[node]]
+            ys = [y for _, y in series[node]]
+            ax.plot(xs, ys, label=node, drawstyle="steps-post")
+        ax.set_xlabel("time (s)")
+        ax.set_ylabel("clock offset (ms)")
+        ax.legend(fontsize=6, ncol=3)
+        fig.savefig(os.path.join(store_dir, "clock.png"), dpi=100)
+        plt.close(fig)
 
 
 class Noop(Checker):

@@ -54,10 +54,18 @@ def build_parser() -> argparse.ArgumentParser:
         s.add_argument("--snapshot-count", type=int, default=100)
         s.add_argument("--seed", type=int, default=0)
         s.add_argument("--debug", action="store_true")
+        s.add_argument("--tcpdump", action="store_true",
+                       help="record a message-level network trace to "
+                            "store/<run>/trace.jsonl (db.clj:276-277)")
         s.add_argument("--test-count", type=int, default=1)
         s.add_argument("--only-workloads-expected-to-pass",
                        action="store_true")
         s.add_argument("--store", default="store")
+    srv = sub.add_parser("serve", help="serve the store dir over HTTP "
+                                       "(etcd.clj:250-252)")
+    srv.add_argument("--store", default="store")
+    srv.add_argument("-p", "--port", type=int, default=8080)
+    srv.add_argument("-b", "--bind", default="127.0.0.1")
     return p
 
 
@@ -99,6 +107,7 @@ def opts_from_args(args) -> dict:
         "snapshot_count": args.snapshot_count,
         "seed": args.seed,
         "debug": args.debug,
+        "tcpdump": args.tcpdump,
         "store_base": args.store,
     }
 
@@ -122,6 +131,9 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
     args = build_parser().parse_args(argv)
+    if args.command == "serve":
+        from .serve import serve_store
+        return serve_store(args.store, args.port, args.bind)
     if args.command == "test":
         opts = opts_from_args(args)
         ok = True

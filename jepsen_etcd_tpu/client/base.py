@@ -73,6 +73,10 @@ class Client:
         if not self.open:
             raise SimError("closed-client", self.node)
         loop = current_loop()
+        if self.cluster.tracer is not None:
+            method = getattr(coro, "__qualname__", "rpc").split(".")[-1]
+            self.cluster.tracer.record("client-rpc", "client", self.node,
+                                       method=method)
         task = loop.spawn(coro, name=f"rpc-{self.node}")
         return await wait_for(task, timeout)
 
@@ -189,14 +193,37 @@ class Client:
 
     # ---- membership (client.clj:571-636) ----------------------------------
 
-    async def member_list(self) -> list[str]:
+    async def member_list(self) -> list[dict]:
+        """Member maps {id, name, peer-urls, client-urls}
+        (list-members, client.clj:571-579)."""
         return await self._call(self.cluster.member_list(self.node))
+
+    async def member_id_of_node(self, node: str) -> int:
+        """node name -> member id (member-id-of-node, client.clj:581-595);
+        raises if the node is not a member."""
+        for m in await self.member_list():
+            if m["name"] == node:
+                return m["id"]
+        raise SimError("member-not-found", node)
+
+    async def node_of_member_id(self, member_id: int) -> str:
+        """member id -> node name (node-of-member-id, client.clj:597-613);
+        raises if no member has that id."""
+        for m in await self.member_list():
+            if m["id"] == member_id:
+                return m["name"]
+        raise SimError("member-not-found", hex(member_id))
 
     async def add_member(self, name: str) -> None:
         await self._call(self.cluster.member_add(self.node, name))
 
     async def remove_member(self, name: str) -> None:
         await self._call(self.cluster.member_remove(self.node, name))
+
+    async def remove_member_by_id(self, member_id: int) -> None:
+        """Remove by id like the reference's remove-member!
+        (client.clj:624-636 resolves the id first)."""
+        await self.remove_member(await self.node_of_member_id(member_id))
 
     # ---- maintenance (client.clj:638-661) ---------------------------------
 

@@ -47,6 +47,10 @@ class Db:
         await gather(*[loop.spawn(c.await_node_ready())
                        for c in clients])
         self.initialized = True  # jepsen/synchronize barrier passed
+        if test.get("lazyfs"):
+            # pin the post-setup state (lazyfs checkpoint!, db.clj:222-223)
+            for n in test["nodes"]:
+                cluster.checkpoint_node(n)
 
     async def teardown(self, test: dict) -> None:
         test["cluster"].shutdown()

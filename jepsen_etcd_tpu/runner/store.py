@@ -17,13 +17,26 @@ _seq = itertools.count()
 
 
 def make_store_dir(base: str, test_name: str) -> str:
+    """Create the next run dir. `latest` symlinks are NOT repointed here
+    — the dir is made before the run executes (debug provenance needs
+    its name), and a crashed run must not leave `latest` dangling at an
+    empty dir; save_run repoints them once artifacts exist."""
     os.makedirs(base, exist_ok=True)
     existing = sorted(os.listdir(os.path.join(base, test_name))) \
         if os.path.isdir(os.path.join(base, test_name)) else []
     run_id = f"{len([e for e in existing if not e.startswith('latest')]):05d}"
     path = os.path.join(base, test_name, run_id)
     os.makedirs(path, exist_ok=True)
-    for link_base, target in ((os.path.join(base, test_name), run_id),
+    return path
+
+
+def link_latest(store_dir: str) -> None:
+    """Point store/<test>/latest and store/latest at a completed run."""
+    run_id = os.path.basename(store_dir)
+    test_dir = os.path.dirname(store_dir)
+    base = os.path.dirname(test_dir)
+    test_name = os.path.basename(test_dir)
+    for link_base, target in ((test_dir, run_id),
                               (base, os.path.join(test_name, run_id))):
         link = os.path.join(link_base, "latest")
         try:
@@ -32,7 +45,6 @@ def make_store_dir(base: str, test_name: str) -> str:
             os.symlink(target, link)
         except OSError:
             pass
-    return path
 
 
 def _scrub(x: Any):
@@ -49,6 +61,7 @@ def _scrub(x: Any):
 
 def save_run(store_dir: str, test: dict, history, results: dict,
              node_logs: dict) -> None:
+    link_latest(store_dir)
     with open(os.path.join(store_dir, "history.jsonl"), "w") as f:
         f.write(history.to_jsonl())
     with open(os.path.join(store_dir, "results.json"), "w") as f:

@@ -14,6 +14,7 @@ from typing import Any, Optional
 from ..core.op import Op, NEMESIS
 from ..core.history import History
 from ..sut.cluster import Cluster, ClusterConfig
+from ..sut.errors import SimError
 from .sim import SimLoop, set_current_loop, current_loop
 from .interpreter import interpret
 from .store import make_store_dir, save_run
@@ -61,17 +62,46 @@ class ClientPool:
                 c.close(self.test)
 
 
+#: client-side task-name prefixes; anything of these still live after
+#: teardown + grace is a leaked client task (the sshj thread-leak
+#: analog, support.clj:57-72)
+_CLIENT_TASK_PREFIXES = ("rpc-", "keepalive-", "worker-", "evget")
+
+
+def check_task_leaks(loop, where: str = "post-run") -> None:
+    """Scan the SimLoop for live client tasks and throw, like the
+    reference's pre-run sshj thread-leak scan (support.clj:57-72 throws
+    :sshj-thread-leak with the offending stacks)."""
+    from ..sut.errors import SimError
+    leaked = [t.name for t in loop.tasks
+              if not t.done and t.name.startswith(_CLIENT_TASK_PREFIXES)]
+    if leaked:
+        raise SimError("task-leak",
+                       f"{where}: live client tasks: {sorted(leaked)[:16]} "
+                       f"({len(leaked)} total)")
+
+
 def run_test(test: dict) -> dict:
     """Run a composed test map; returns {valid?, results, history, dir}."""
     seed = test.get("seed", 0)
     loop = SimLoop(seed=seed)
     set_current_loop(loop)
     t0 = wall_time.time()
+    # store dir exists before ops run, so debug-mode provenance can embed
+    # the run's dir name in written values (the reference's store/path is
+    # likewise available during the run, append.clj:40)
+    store_dir = make_store_dir(test.get("store_base", "store"),
+                               test.get("name", "test"))
+    test["store_dir"] = store_dir
     try:
         cluster = Cluster(loop, list(test["nodes"]),
                           test.get("cluster_config") or ClusterConfig(
                               lazyfs=bool(test.get("lazyfs"))))
         test["cluster"] = cluster
+        if test.get("tcpdump"):
+            # network-event trace (the --tcpdump analog, db.clj:276-277)
+            from .trace import NetTrace
+            cluster.tracer = NetTrace(loop)
         db = test["db"]
         pool = ClientPool(test)
         nemesis_obj = test.get("nemesis")
@@ -99,21 +129,40 @@ def run_test(test: dict) -> dict:
             if nemesis_obj is not None:
                 await nemesis_obj.teardown(test)
             await db.teardown(test)
+            # grace: let closed clients' pumps observe closure, timed-out
+            # rpcs cancel (5 s client timeout), then scan for leaked
+            # client tasks
+            from .sim import sleep, SECOND
+            await sleep(6 * SECOND)
             return h
 
         history = loop.run_coro(main())
         sim_seconds = loop.now / 1e9
+        # leak scan AFTER the run, recorded into results rather than
+        # thrown — a leak must not destroy the run's artifacts (they're
+        # the evidence needed to debug it)
+        task_leak = None
+        try:
+            check_task_leaks(loop)
+        except SimError as e:
+            logger.error("task leak detected: %s", e)
+            task_leak = str(e)
     finally:
         set_current_loop(None)
 
-    store_dir = make_store_dir(test.get("store_base", "store"),
-                               test.get("name", "test"))
     logger.info("Analyzing %d ops (history in %s)", len(history), store_dir)
     results = test["checker"].check(test, history,
                                     {"store_dir": store_dir})
+    if task_leak is not None:
+        results["task-leak"] = {"valid?": False, "error": task_leak}
+        results["valid?"] = False
     node_logs = {name: list(node.etcd_log)
                  for name, node in cluster.nodes.items()}
     save_run(store_dir, test, history, results, node_logs)
+    if cluster.tracer is not None:
+        import os
+        with open(os.path.join(store_dir, "trace.jsonl"), "w") as f:
+            f.write(cluster.tracer.to_jsonl())
     wall = wall_time.time() - t0
     logger.info("Run complete: valid?=%s (%d ops, %.1f sim-s, %.2f wall-s)",
                 results.get("valid?"), len(history), sim_seconds, wall)

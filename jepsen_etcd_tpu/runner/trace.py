@@ -1,0 +1,42 @@
+"""Network-event trace recorder (the ``--tcpdump`` analog).
+
+The reference captures client-port pcaps per node (db.clj:276-277);
+in the simulated net the equivalent is a message-level event log:
+client->node RPCs and node->node replication/vote traffic, each with
+virtual timestamps and payload summaries, written to
+``store/<run>/trace.jsonl``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+class NetTrace:
+    """Append-only in-memory message trace; one dict per event."""
+
+    def __init__(self, loop, max_events: int = 2_000_000):
+        self.loop = loop
+        self.events: list[dict] = []
+        self.dropped = 0
+        self.max_events = max_events
+
+    def record(self, kind: str, src: str, dst: str, **info: Any) -> None:
+        if len(self.events) >= self.max_events:
+            self.dropped += 1
+            return
+        self.events.append({"t": self.loop.now, "kind": kind,
+                            "src": src, "dst": dst, **info})
+
+    def counts(self) -> dict:
+        out: dict = {}
+        for e in self.events:
+            out[e["kind"]] = out.get(e["kind"], 0) + 1
+        return out
+
+    def to_jsonl(self) -> str:
+        lines = [json.dumps(e, default=repr) for e in self.events]
+        if self.dropped:
+            lines.append(json.dumps({"truncated": self.dropped}))
+        return "\n".join(lines)

@@ -1,0 +1,103 @@
+"""``serve``: browse saved test runs over local HTTP.
+
+Re-designs the reference's ``lein run serve`` (etcd.clj:250-252, jepsen's
+built-in web server): the store dir is served with a generated index of
+runs at ``/`` — each linking its results.json, timeline.html, perf PNGs,
+trace, and node logs — and plain file/directory serving below it.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import os
+from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import quote
+
+
+def _run_rows(store_base: str) -> list[dict]:
+    from .forensics import all_runs
+    rows = []
+    for rdir in all_runs(store_base):
+        rel = os.path.relpath(rdir, store_base)
+        row = {"dir": rel, "valid?": "?", "files": []}
+        results = os.path.join(rdir, "results.json")
+        if os.path.exists(results):
+            try:
+                with open(results) as f:
+                    row["valid?"] = json.load(f).get("valid?")
+            except (OSError, json.JSONDecodeError):
+                row["valid?"] = "unreadable"
+        for fn in sorted(os.listdir(rdir)):
+            row["files"].append(fn)
+        rows.append(row)
+    return rows
+
+
+def index_html(store_base: str) -> str:
+    rows = []
+    # newest first by mtime — run ids are per-test sequence numbers, so
+    # path order is not recency across test names
+    ordered = sorted(
+        _run_rows(store_base),
+        key=lambda r: os.path.getmtime(os.path.join(store_base, r["dir"])),
+        reverse=True)
+    for r in ordered:
+        color = {"True": "#2a2", True: "#2a2",
+                 False: "#c22", "False": "#c22"}.get(r["valid?"], "#b80")
+        files = " ".join(
+            f'<a href="/{quote(r["dir"])}/{quote(fn)}">{html.escape(fn)}</a>'
+            for fn in r["files"])
+        rows.append(
+            f'<tr><td><a href="/{quote(r["dir"])}/">'
+            f'{html.escape(r["dir"])}</a></td>'
+            f'<td style="color:{color}">{html.escape(str(r["valid?"]))}</td>'
+            f"<td>{files}</td></tr>")
+    return ("<!doctype html><title>jepsen_etcd_tpu store</title>"
+            "<h1>Test runs</h1>"
+            "<table border=1 cellpadding=4><tr><th>run</th>"
+            "<th>valid?</th><th>artifacts</th></tr>"
+            + "".join(rows) + "</table>")
+
+
+class StoreHandler(SimpleHTTPRequestHandler):
+    """Serves the store dir; '/' renders the generated run index."""
+
+    store_base = "store"
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, directory=self.store_base, **kw)
+
+    def do_GET(self):
+        if self.path in ("/", "/index.html"):
+            body = index_html(self.store_base).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        super().do_GET()
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+
+def make_server(store_base: str, port: int = 0,
+                bind: str = "127.0.0.1") -> ThreadingHTTPServer:
+    handler = type("Handler", (StoreHandler,), {"store_base": store_base})
+    return ThreadingHTTPServer((bind, port), handler)
+
+
+def serve_store(store_base: str, port: int = 8080,
+                bind: str = "127.0.0.1") -> int:
+    srv = make_server(store_base, port, bind)
+    host, p = srv.server_address[:2]
+    print(f"Serving {store_base} at http://{host}:{p}/ (ctrl-c to stop)")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()
+    return 0

@@ -47,6 +47,16 @@ logger = logging.getLogger("jepsen_etcd_tpu.sut")
 MS = 1_000_000  # virtual ns
 
 
+def member_id(name: str) -> int:
+    """Stable 64-bit member id for a node name (etcd derives member ids
+    by hashing peer URLs; grow always mints fresh names, so a name-hash
+    is equally unique and — unlike real etcd — reproducible across
+    seeds)."""
+    import hashlib
+    return int.from_bytes(
+        hashlib.sha1(name.encode()).digest()[:8], "big") & (2 ** 63 - 1)
+
+
 @dataclass
 class ClusterConfig:
     election_timeout: int = 1000 * MS     # etcd default 1s
@@ -327,6 +337,11 @@ class Cluster:
         self.running = False
         self._tick_task = None
         self.next_lease_id = 0x70000000
+        self.tracer = None  # runner.trace.NetTrace when --tcpdump is set
+
+    def _trace(self, kind: str, src: str, dst: str, **info: Any) -> None:
+        if self.tracer is not None:
+            self.tracer.record(kind, src, dst, **info)
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -408,7 +423,11 @@ class Cluster:
         peer = self.nodes.get(peer_name)
         if (peer is None or peer.removed
                 or not self.reachable(cand.name, peer_name)):
+            self._trace("vote-req", cand.name, peer_name, term=term,
+                        delivered=False)
             return
+        self._trace("vote-req", cand.name, peer_name, term=term,
+                    delivered=True)
         granted = False
         if peer.term <= term:
             if peer.term < term:
@@ -426,7 +445,10 @@ class Cluster:
         resp_term = peer.term
         # response leg
         await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
-        if not self.reachable(peer_name, cand.name):
+        delivered = self.reachable(peer_name, cand.name)
+        self._trace("vote-resp", peer_name, cand.name, term=resp_term,
+                    granted=granted, delivered=delivered)
+        if not delivered:
             return
         if resp_term > cand.term:
             # may already have won and accepted proposals: fail their
@@ -501,7 +523,11 @@ class Cluster:
         if (peer is None or leader.role != "leader" or not leader.alive
                 or not self.reachable(leader.name, peer_name)
                 or peer.removed):
+            self._trace("append", leader.name, peer_name, term=leader.term,
+                        delivered=False)
             return
+        self._trace("append", leader.name, peer_name, term=leader.term,
+                    commit=leader.commit_index, delivered=True)
         if peer.term > leader.term:
             leader.term = peer.term
             leader.role = "follower"
@@ -578,6 +604,8 @@ class Cluster:
             peer.apply_up_to_commit()
 
     def _install_snapshot(self, leader: Node, peer: Node) -> None:
+        self._trace("snapshot", leader.name, peer.name,
+                    index=leader.snap_index, delivered=True)
         snap_items, err = walmod.decode_records(leader.snap_current)
         if err or not snap_items:
             # leader snapshot bytes damaged: send live state (etcd would
@@ -904,9 +932,14 @@ class Cluster:
 
     # ---- membership ---------------------------------------------------------
 
-    async def member_list(self, node_name: str) -> list[str]:
+    async def member_list(self, node_name: str) -> list[dict]:
+        """Member maps with etcd-style ids and URLs (client.clj:571-613;
+        URL scheme peer 2380 / client 2379 per support.clj:12-25)."""
         n = await self._enter(node_name)
-        return list(n.membership)
+        return [{"id": member_id(m), "name": m,
+                 "peer-urls": [f"http://{m}:2380"],
+                 "client-urls": [f"http://{m}:2379"]}
+                for m in n.membership]
 
     async def member_add(self, via_node: str, new_name: str) -> None:
         n = await self._enter(via_node)
@@ -1077,10 +1110,20 @@ class Cluster:
         n.log_line(f"file corrupted: {which} ({mode})")
 
     def wipe_node(self, name: str) -> None:
-        """Remove all durable state (db.clj:29-36 wipe!)."""
+        """Remove all durable state (db.clj:29-36 wipe!); the removal is
+        itself durable (the reference checkpoints lazyfs right after the
+        rm -rf so wiped files can't come back when unsynced writes are
+        later dropped)."""
         n = self.nodes[name]
         n.wal_current = n.wal_durable = b""
         n.snap_current = n.snap_durable = b""
+
+    def checkpoint_node(self, name: str) -> None:
+        """lazyfs checkpoint! analog (db.clj:35-36): flush current file
+        state to durable, pinning it as the rollback floor for future
+        lose-unfsynced kills. Called after setup (db.clj:222-223) so a
+        kill never rolls a node back past its initial ready state."""
+        self.nodes[name].fsync()
 
     # ---- invariants ---------------------------------------------------------
 

@@ -37,6 +37,8 @@ ERROR_TYPES: dict[str, bool] = {
     "paused": False,                     # SIGSTOP'd node: hangs -> timeout
     "nonmonotonic-watch": True,          # watch.clj:161-177 definite throw
     "corrupt": True,                     # corruption alarm / refuse to serve
+    "task-leak": True,                   # sshj thread-leak analog,
+                                         # support.clj:57-72
 }
 
 

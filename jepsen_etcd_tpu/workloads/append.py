@@ -27,6 +27,7 @@ from ..client import txn as t
 from ..checkers.elle.append import ListAppendChecker
 from ..generators.elle import list_append_gen
 from .base import WorkloadClient
+from .debug import encode_put, decode_get, attach_debug
 
 
 def ekey(k) -> str:
@@ -59,26 +60,36 @@ class AppendTxnClient(WorkloadClient):
                 else:
                     guards.append(t.lt(ekey(k),
                                        t.mod_revision(read_revision)))
-            state = {k: list(kv["value"]) for k, kv in reads.items()
-                     if kv is not None}
+            state = {k: list(decode_get(test, kv["value"]))
+                     for k, kv in reads.items() if kv is not None}
             ast = []
             for f, k, v in mops:
                 if f == "r":
                     ast.append(t.get(ekey(k)))
                 else:
                     state[k] = state.get(k, []) + [v]
-                    ast.append(t.put(ekey(k), list(state[k])))
+                    ast.append(t.put(ekey(k),
+                                     encode_put(test, op, list(state[k]))))
             res = await self.conn.txn(guards, ast)
             if not res["succeeded"]:
-                return op.evolve(type="fail", error="didnt-succeed")
+                return attach_debug(test, op.evolve(
+                    type="fail", error="didnt-succeed"),
+                    read_res={"reads": reads,
+                              "read-revision": read_revision},
+                    txn_res=res)
             txn_out = []
             for (f, k, v), (_, payload) in zip(mops, res["results"]):
                 if f == "append":
                     txn_out.append([f, k, v])
                 else:
-                    txn_out.append(
-                        [f, k, list(payload["value"]) if payload else None])
-            return op.evolve(type="ok", value=txn_out)
+                    val = decode_get(test, payload["value"]) \
+                        if payload else None
+                    txn_out.append([f, k, list(val)
+                                    if val is not None else None])
+            return attach_debug(
+                test, op.evolve(type="ok", value=txn_out),
+                read_res={"reads": reads, "read-revision": read_revision},
+                txn_res=res)
 
         return await with_errors(op, set(), go)
 

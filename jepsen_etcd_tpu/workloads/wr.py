@@ -16,6 +16,7 @@ from ..client import txn as t
 from ..checkers.elle.wr import RWRegisterChecker
 from ..generators.elle import rw_register_gen
 from .base import WorkloadClient
+from .debug import encode_put, decode_get, attach_debug
 
 
 def ekey(k) -> str:
@@ -26,19 +27,23 @@ class WrTxnClient(WorkloadClient):
     async def invoke(self, test: dict, op: Op) -> Op:
         async def go():
             mops = op.value
-            ast = [t.get(ekey(k)) if f == "r" else t.put(ekey(k), v)
+            ast = [t.get(ekey(k)) if f == "r"
+                   else t.put(ekey(k), encode_put(test, op, v))
                    for f, k, v in mops]
             res = await self.conn.txn([], ast)
             if not res["succeeded"]:
-                return op.evolve(type="fail", error="didnt-succeed")
+                return attach_debug(test, op.evolve(
+                    type="fail", error="didnt-succeed"), txn_res=res)
             txn_out = []
             for (f, k, v), (_, payload) in zip(mops, res["results"]):
                 if f == "w":
                     txn_out.append([f, k, v])
                 else:
                     txn_out.append(
-                        [f, k, payload["value"] if payload else None])
-            return op.evolve(type="ok", value=txn_out)
+                        [f, k, decode_get(test, payload["value"])
+                         if payload else None])
+            return attach_debug(test, op.evolve(type="ok", value=txn_out),
+                                txn_res=res)
 
         return await with_errors(op, set(), go)
 

@@ -1,0 +1,263 @@
+"""Auxiliary-subsystem tests: --debug provenance + forensics, network
+trace recorder (--tcpdump), serve, task-leak check, lazyfs checkpoint,
+clock plot rendering, member-id surface (VERDICT r1 items 6-10 +
+missing #8 + weak #5)."""
+
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from jepsen_etcd_tpu.compose import etcd_test
+from jepsen_etcd_tpu.runner.test_runner import run_test, check_task_leaks
+from jepsen_etcd_tpu import forensics
+from jepsen_etcd_tpu.core.op import Op
+
+
+def run(tmp_path, **opts):
+    base = {"time_limit": 10, "rate": 50, "store_base": str(tmp_path),
+            "seed": 4}
+    base.update(opts)
+    return run_test(etcd_test(base))
+
+
+# ---- debug provenance + forensics -----------------------------------------
+
+def test_debug_provenance_wr(tmp_path):
+    out = run(tmp_path, workload="wr", debug=True)
+    assert out["results"]["workload"]["valid?"] is True
+    oks = [op for op in out["history"]
+           if op.get("type") == "ok" and op.get("f") == "txn"]
+    assert oks, "no committed txns"
+    # every committed txn carries raw responses for forensics
+    assert all(isinstance(op.get("debug"), dict)
+               and "txn-res" in op["debug"] for op in oks)
+    # checker-visible read values are unwrapped (plain ints), but the
+    # raw responses contain the provenance wrapper with this run's dir
+    dirs = forensics.txn_dirs(out["history"])
+    expected = (os.path.basename(os.path.dirname(out["dir"])) + "/"
+                + os.path.basename(out["dir"]))
+    assert dirs <= {expected}
+    assert dirs, "no provenance-wrapped values ever read back"
+    # revision maps extract, and a healthy run has no duplicates
+    revs = forensics.wr_ops_revisions(oks)
+    assert revs and all(r["key"] is not None and r["mod-revision"] is not None
+                        for r in revs)
+    assert forensics.duplicate_revisions(oks) == {}
+
+
+def test_debug_provenance_append(tmp_path):
+    out = run(tmp_path, workload="append", debug=True)
+    assert out["results"]["workload"]["valid?"] is True
+    oks = [op for op in out["history"]
+           if op.get("type") == "ok" and op.get("f") == "txn"]
+    assert oks
+    assert all("read-res" in op["debug"] and "txn-res" in op["debug"]
+               for op in oks if op.get("debug"))
+    # reads stitched into txn values are decoded lists, not wrappers
+    for op in oks:
+        for f, k, v in op["value"]:
+            if f == "r" and v is not None:
+                assert isinstance(v, list), (f, k, v)
+
+
+def test_forensics_on_saved_store(tmp_path):
+    out = run(tmp_path, workload="wr", debug=True)
+    runs = forensics.all_runs(str(tmp_path))
+    assert out["dir"] in runs
+    h = forensics.load_history(out["dir"])
+    assert forensics.txn_dirs(h) == forensics.txn_dirs(out["history"])
+    per_run = forensics.all_txn_dirs(str(tmp_path))
+    assert out["dir"] in per_run
+
+
+def test_duplicate_revisions_detects():
+    # two reads observing the same (key, value) at different
+    # mod-revisions — the anomaly the reference hunted (etcd.clj:337-346)
+    def dbg_read(kv):
+        return {"txn-res": {"results": [("get", kv)]}}
+
+    ops = [
+        Op(type="ok", f="txn", index=1, value=[["r", "x", [1]]],
+           debug=dbg_read({"key": "x", "value": [1], "mod-revision": 5})),
+        Op(type="ok", f="txn", index=2, value=[["r", "x", [1]]],
+           debug=dbg_read({"key": "x", "value": [1], "mod-revision": 9})),
+    ]
+    dups = forensics.duplicate_revisions(ops)
+    assert len(dups) == 1
+    (key, _val), rms = next(iter(dups.items()))
+    assert key == "x" and {r["mod-revision"] for r in rms} == {5, 9}
+    assert forensics.ops_involving("x", ops) == ops
+
+
+# ---- network trace recorder ------------------------------------------------
+
+def test_trace_recorder(tmp_path):
+    out = run(tmp_path, workload="register", nemesis=["partition"],
+              tcpdump=True, time_limit=20, seed=3, nemesis_interval=3)
+    assert any(op.get("f") == "start-partition"
+               for op in out["history"]), "seed produced no partition"
+    trace_path = os.path.join(out["dir"], "trace.jsonl")
+    assert os.path.exists(trace_path)
+    events = [json.loads(l) for l in open(trace_path) if l.strip()]
+    counts = {}
+    for e in events:
+        counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+    # replication heartbeats dominate; client rpcs and vote traffic exist
+    assert counts.get("append", 0) > 100
+    assert counts.get("client-rpc", 0) > 50
+    assert counts.get("vote-req", 0) >= 4, counts
+    # virtual timestamps are monotone
+    ts = [e["t"] for e in events]
+    assert all(a <= b for a, b in zip(ts, ts[1:]))
+    # partitions drop messages
+    assert any(e.get("delivered") is False for e in events)
+
+
+def test_no_trace_without_flag(tmp_path):
+    out = run(tmp_path, workload="register", time_limit=5)
+    assert not os.path.exists(os.path.join(out["dir"], "trace.jsonl"))
+
+
+# ---- serve -----------------------------------------------------------------
+
+def test_serve_store(tmp_path):
+    out = run(tmp_path, workload="register", time_limit=5)
+    from jepsen_etcd_tpu.serve import make_server
+    srv = make_server(str(tmp_path), port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        port = srv.server_address[1]
+        idx = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/").read().decode()
+        rel = os.path.relpath(out["dir"], str(tmp_path))
+        assert rel in idx and "results.json" in idx
+        res = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/{rel}/results.json")
+        assert res.status == 200
+        assert json.load(res).get("valid?") is True
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---- task-leak check -------------------------------------------------------
+
+def test_task_leak_check_raises():
+    from jepsen_etcd_tpu.runner.sim import SimLoop, set_current_loop
+    from jepsen_etcd_tpu.sut.errors import SimError
+    loop = SimLoop(seed=0)
+    set_current_loop(loop)
+    try:
+        async def stuck():
+            await loop.future()  # never resolves
+
+        loop.spawn(stuck(), name="rpc-n1")
+        with pytest.raises(SimError) as ei:
+            check_task_leaks(loop)
+        assert ei.value.type == "task-leak"
+        assert "rpc-n1" in str(ei.value)
+    finally:
+        set_current_loop(None)
+
+
+def test_runs_pass_leak_check(tmp_path):
+    # the check runs inside every run_test; lock workloads spawn
+    # keepalive pumps — they must all drain
+    out = run(tmp_path, workload="lock", time_limit=10)
+    assert out["history"] is not None
+
+
+# ---- lazyfs checkpoint -----------------------------------------------------
+
+def test_lazyfs_checkpoint_pins_setup_state():
+    from jepsen_etcd_tpu.runner.sim import SimLoop, set_current_loop, sleep
+    from jepsen_etcd_tpu.sut import Cluster, ClusterConfig, Txn
+    from jepsen_etcd_tpu.sut.cluster import MS
+    loop = SimLoop(seed=2)
+    set_current_loop(loop)
+    try:
+        cluster = Cluster(loop, ["n1", "n2", "n3"],
+                          ClusterConfig(unsafe_no_fsync=True, lazyfs=True))
+        cluster.launch()
+
+        async def main():
+            while not any(n.role == "leader"
+                          for n in cluster.nodes.values()):
+                await sleep(100 * MS)
+            await cluster.kv_txn(
+                "n1", Txn((), (("put", "pinned", 1, 0),), ()))
+            await sleep(500 * MS)
+            for n in cluster.nodes:
+                cluster.checkpoint_node(n)   # lazyfs checkpoint!
+            await cluster.kv_txn(
+                "n1", Txn((), (("put", "after", 2, 0),), ()))
+            await sleep(200 * MS)
+            # kill ALL nodes losing unfsynced writes; restart
+            for n in list(cluster.nodes):
+                cluster.kill_node(n, lose_unfsynced=True)
+            for n in list(cluster.nodes):
+                cluster.start_node(n)
+            while not any(n.role == "leader"
+                          for n in cluster.nodes.values()):
+                await sleep(100 * MS)
+            out = await cluster.kv_read("n1", "pinned")
+            # the checkpointed write survives total crash; the
+            # post-checkpoint write may legitimately be lost
+            assert out["kv"] is not None and out["kv"]["value"] == 1
+
+        loop.run_coro(main())
+        cluster.shutdown()
+    finally:
+        set_current_loop(None)
+
+
+# ---- clock plot ------------------------------------------------------------
+
+def test_clock_plot_renders(tmp_path):
+    out = run(tmp_path, workload="register", nemesis=["clock"],
+              time_limit=15)
+    clock = out["results"].get("clock", {})
+    assert clock.get("valid?") is True
+    if clock.get("points"):
+        assert clock.get("plots") == ["clock.png"], clock.get("plot-error")
+        assert os.path.exists(os.path.join(out["dir"], "clock.png"))
+
+
+# ---- member ids ------------------------------------------------------------
+
+def test_member_id_surface():
+    from jepsen_etcd_tpu.runner.sim import SimLoop, set_current_loop, sleep
+    from jepsen_etcd_tpu.sut import Cluster
+    from jepsen_etcd_tpu.sut.cluster import MS, member_id
+    from jepsen_etcd_tpu.client import DirectClient
+    loop = SimLoop(seed=1)
+    set_current_loop(loop)
+    try:
+        cluster = Cluster(loop, ["n1", "n2", "n3"])
+        cluster.launch()
+
+        async def main():
+            while not any(n.role == "leader"
+                          for n in cluster.nodes.values()):
+                await sleep(100 * MS)
+            c = DirectClient(cluster, "n1")
+            ms = await c.member_list()
+            assert {m["name"] for m in ms} == {"n1", "n2", "n3"}
+            ids = {m["id"] for m in ms}
+            assert len(ids) == 3 and all(isinstance(i, int) for i in ids)
+            mid = await c.member_id_of_node("n2")
+            assert mid == member_id("n2")
+            assert await c.node_of_member_id(mid) == "n2"
+            await c.remove_member_by_id(mid)
+            await sleep(2000 * MS)
+            ms2 = await c.member_list()
+            assert {m["name"] for m in ms2} == {"n1", "n3"}
+
+        loop.run_coro(main())
+        cluster.shutdown()
+    finally:
+        set_current_loop(None)

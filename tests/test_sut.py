@@ -302,17 +302,22 @@ def test_membership_add_remove(sim):
         # remove n5 (the leader may remove itself; allow re-election time)
         await cluster.member_remove("n1", "n5")
         deadline = loop.now + 15 * SECOND
-        members = None
+        names = None
         while loop.now < deadline:
             await sleep(500 * MS)
             try:
-                members = await cluster.member_list("n1")
+                ms = await cluster.member_list("n1")
             except SimError:
                 continue
-            if "n5" not in members:
+            names = [m["name"] for m in ms]
+            if "n5" not in names:
                 break
-        assert members is not None and "n5" not in members \
-            and len(members) == 4
+        assert names is not None and "n5" not in names and len(names) == 4
+        # member maps carry stable etcd-style ids + URL scheme
+        assert all(isinstance(m["id"], int) and
+                   m["peer-urls"] == [f"http://{m['name']}:2380"]
+                   for m in ms)
+        members = names
         # ops against the removed node fail definitely
         with pytest.raises(SimError) as ei:
             await cluster.kv_txn("n5", put_txn("m", 2))
