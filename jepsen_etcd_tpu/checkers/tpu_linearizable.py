@@ -26,10 +26,20 @@ class TPULinearizableChecker(Checker):
         self.fallback = fallback
         self.f_max = f_max
 
-    def _kernel_ok(self) -> bool:
-        # The kernel implements exactly VersionedRegister(0, None); any
-        # other model/initial state must take the CPU path.
-        return self.model_fn() == VersionedRegister(0, None)
+    def _pack_fn(self):
+        """The kernel packing for this model, or None for CPU-only
+        models. VersionedRegister(0, None) packs natively; Mutex packs
+        through the CAS-register adapter (a mutex IS a 2-value CAS
+        register) — so the lock workloads' Knossos check (lock.clj:244)
+        also runs on-device."""
+        from ..ops import wgl
+        from ..models import Mutex
+        m = self.model_fn()
+        if m == VersionedRegister(0, None):
+            return wgl.pack_register_history
+        if m == Mutex(False):
+            return wgl.pack_mutex_history
+        return None
 
     def _finalize(self, history, out: dict) -> dict:
         """Post-process one kernel verdict into a checker result,
@@ -61,10 +71,10 @@ class TPULinearizableChecker(Checker):
 
     def check(self, test, history, opts=None) -> dict:
         from ..ops import wgl
-        if not self._kernel_ok():
-            return self._fallback(
-                history, "model is not VersionedRegister(0, None)")
-        p = wgl.pack_register_history(history)
+        pack = self._pack_fn()
+        if pack is None:
+            return self._fallback(history, "model has no kernel packing")
+        p = pack(history)
         if not p.ok:
             return self._fallback(history, p.reason)
         return self._finalize(history, wgl.check_packed(p, f_max=self.f_max))
@@ -75,10 +85,11 @@ class TPULinearizableChecker(Checker):
         DP axis). Called by checkers.Independent; falls back per key."""
         from ..ops import wgl
         keys = list(subhistories)
-        if not self._kernel_ok():
+        pack = self._pack_fn()
+        if pack is None:
             return {k: self.check(test, subhistories[k], opts)
                     for k in keys}
-        packs = [wgl.pack_register_history(subhistories[k]) for k in keys]
+        packs = [pack(subhistories[k]) for k in keys]
         outs = wgl.check_packed_batch(packs, f_max=self.f_max)
         # unpackable keys come back "unknown" with the pack reason;
         # _finalize routes those through the CPU fallback

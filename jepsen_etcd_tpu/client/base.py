@@ -71,6 +71,7 @@ class Client:
     async def _call(self, coro, timeout: int = TIMEOUT) -> Any:
         """Issue an RPC with the client timeout."""
         if not self.open:
+            coro.close()  # silence "never awaited" — arg already built
             raise SimError("closed-client", self.node)
         loop = current_loop()
         if self.cluster.tracer is not None:

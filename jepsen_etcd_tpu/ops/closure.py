@@ -35,6 +35,8 @@ if HAVE_JAX:
 
 #: below this node count, numpy squaring beats a device round-trip
 CPU_CUTOFF = 256
+#: at/above this node count (with >1 device), shard rows over the mesh
+SHARD_CUTOFF = 1024
 
 
 if HAVE_JAX:
@@ -59,6 +61,54 @@ if HAVE_JAX:
         on_cycle = jnp.any(
             jnp.logical_and(a, jnp.swapaxes(reach, -1, -2)), axis=-1)
         return reach, on_cycle
+
+    from functools import lru_cache
+
+    @lru_cache(maxsize=None)
+    def _mesh(devs_key: tuple):
+        from jax.sharding import Mesh
+        return Mesh(np.array(jax.devices()), ("dp",))
+
+    @lru_cache(maxsize=None)
+    def _closure_sharded_jitted(iters: int, devs_key: tuple):
+        """Row-sharded squaring: R is [B, N, N] with rows split over the
+        mesh ('dp'); each R@R is a 1D-sharded matmul — XLA/GSPMD inserts
+        the all-gather of the stationary operand over ICI (SURVEY §2.3
+        "SCC via repeated boolean matmul under pjit sharding"). The
+        sharding constraint in the loop body pins the layout so the
+        gather happens once per squaring, not once per op."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = _mesh(devs_key)
+        sh = NamedSharding(mesh, P(None, "dp", None))
+
+        @jax.jit
+        def run(a):
+            n = a.shape[-1]
+            eye = jnp.eye(n, dtype=bool)
+            r = jnp.logical_or(a, eye[None, :, :]).astype(jnp.bfloat16)
+            r = jax.lax.with_sharding_constraint(r, sh)
+
+            def body(_, r):
+                prod = jax.lax.dot_general(
+                    r, r, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)
+                return jax.lax.with_sharding_constraint(
+                    (prod > 0).astype(jnp.bfloat16), sh)
+
+            r = jax.lax.fori_loop(0, iters, body, r)
+            reach = r > 0
+            on_cycle = jnp.any(
+                jnp.logical_and(a, jnp.swapaxes(reach, -1, -2)), axis=-1)
+            return reach, on_cycle
+
+        return run, sh
+
+    def _closure_device_sharded(pad: np.ndarray, iters: int):
+        devs_key = tuple(id(d) for d in jax.devices())
+        run, sh = _closure_sharded_jitted(iters, devs_key)
+        # single host->sharded transfer (device_put straight from numpy;
+        # jnp.asarray first would commit to one device then reshard)
+        return run(jax.device_put(pad, sh))
 
 
 def _closure_numpy(a: np.ndarray) -> tuple:
@@ -90,10 +140,16 @@ def closure_batch(adj: np.ndarray, force_device: bool | None = None):
     if not use_device(force_device, n, CPU_CUTOFF, "closure_batch"):
         return _closure_numpy(adj)
     m = _bucket(n)
+    n_dev = len(jax.devices())
+    if m % max(1, n_dev):  # row axis must split evenly over the mesh
+        m = ((m + n_dev - 1) // n_dev) * n_dev
     pad = np.zeros((b, m, m), dtype=bool)
     pad[:, :n, :n] = adj
     iters = max(1, math.ceil(math.log2(m)))
-    reach, on_cycle = _closure_device(jnp.asarray(pad), iters)
+    if n_dev > 1 and m >= SHARD_CUTOFF:
+        reach, on_cycle = _closure_device_sharded(pad, iters)
+    else:
+        reach, on_cycle = _closure_device(jnp.asarray(pad), iters)
     reach = np.asarray(reach)[:, :n, :n]
     on_cycle = np.asarray(on_cycle)[:, :n]
     return reach, on_cycle

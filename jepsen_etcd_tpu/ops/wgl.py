@@ -94,11 +94,50 @@ class Packed:
     ipred_frame: Any = None   # [R, I] uint32 (window bits that must be set)
 
 
+MUTEX_LOCKED = "locked"
+
+
+def mutex_adapter(f: str, value):
+    """Express mutex ops as CAS register ops: a mutex IS a two-value CAS
+    register (acquire = cas(None->locked), release = cas(locked->None),
+    no version assertions) — so the lock workloads' Knossos mutex check
+    (lock.clj:244) runs on the same TPU kernel as the register."""
+    if f == "acquire":
+        return "cas", [None, (None, MUTEX_LOCKED)]
+    if f == "release":
+        return "cas", [None, (MUTEX_LOCKED, None)]
+    return None
+
+
+def pack_mutex_history(history, w: int = W, i_max: int = I_MAX) -> Packed:
+    """Pack a mutex (acquire/release) history for the kernel."""
+    return pack_register_history(history, w=w, i_max=i_max,
+                                 adapter=mutex_adapter)
+
+
 def pack_register_history(history, value_ids: Optional[dict] = None,
-                          w: int = W, i_max: int = I_MAX) -> Packed:
+                          w: int = W, i_max: int = I_MAX,
+                          adapter=None) -> Packed:
     """Build the per-depth tables for the kernel. Returns ok=False with a
-    reason when the history needs the CPU path."""
+    reason when the history needs the CPU path. ``adapter`` (optional)
+    maps each entry's (f, value) into register-language (f, value) —
+    models expressible as CAS registers (e.g. Mutex) reuse the whole
+    kernel this way."""
     entries = history_entries(history)
+    if adapter is not None:
+        adapted = {}
+        for e in entries:
+            m = adapter(e.f, e.value)
+            if m is None:
+                return Packed(ok=False,
+                              reason=f"op f={e.f!r} not supported by adapter")
+            adapted[e.i] = m
+
+        def fv(e):
+            return adapted[e.i]
+    else:
+        def fv(e):
+            return e.f, e.value
     req = sorted([e for e in entries if e.required], key=lambda e: e.invoke)
     R = len(req)
     if R == 0:
@@ -124,27 +163,28 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
     a2 = np.zeros(R, dtype=np.int32)
     ver = np.full(R, NO_ASSERT, dtype=np.int32)
     for i, e in enumerate(req):
-        if e.f == "read":
+        ef, ev = fv(e)
+        if ef == "read":
             f[i] = READ
-            rv, rval = e.value if e.value is not None else (None, None)
+            rv, rval = ev if ev is not None else (None, None)
             ver[i] = NO_ASSERT if rv is None else int(rv)
             # A None read value asserts nothing (VersionedRegister.step
             # treats nil op-value as unchecked REGARDLESS of version —
             # an unset-key read [0, None] is constrained via version 0).
             a1[i] = WILDCARD if rval is None else val_id(rval)
-        elif e.f == "write":
+        elif ef == "write":
             f[i] = WRITE
-            wv, wval = e.value
+            wv, wval = ev
             ver[i] = NO_ASSERT if wv is None else int(wv)
             a1[i] = val_id(wval)
-        elif e.f == "cas":
+        elif ef == "cas":
             f[i] = CAS
-            cv, (old, new) = e.value
+            cv, (old, new) = ev
             ver[i] = NO_ASSERT if cv is None else int(cv)
             a1[i] = val_id(old)
             a2[i] = val_id(new)
         else:
-            return Packed(ok=False, reason=f"op f={e.f!r} not supported")
+            return Packed(ok=False, reason=f"op f={ef!r} not supported")
 
     # --- info (indefinite) ops: may linearize any time after their
     # required predecessors, or never. Reads are droppable (invoke value
@@ -153,7 +193,7 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
     sorted_ret = np.sort(ret)
     infos = []
     for e in entries:
-        if e.required or e.f == "read":
+        if e.required or fv(e)[0] == "read":
             continue
         npred = int(np.searchsorted(sorted_ret, e.invoke, side="left"))
         if npred >= R:
@@ -171,18 +211,19 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
     for j, (e, npred) in enumerate(infos):
         i_inv[j] = e.invoke
         i_npred[j] = npred
-        val = e.value if e.value is not None else (None, None)
-        if e.f == "write":
+        ef, ev = fv(e)
+        val = ev if ev is not None else (None, None)
+        if ef == "write":
             i_f[j] = WRITE
             i_a1[j] = val_id(val[1])
-        elif e.f == "cas" and isinstance(val[1], (list, tuple)) \
+        elif ef == "cas" and isinstance(val[1], (list, tuple)) \
                 and len(val[1]) == 2:
             i_f[j] = CAS
             old, new = val[1]
             i_a1[j] = val_id(old)
             i_a2[j] = val_id(new)
         else:
-            return Packed(ok=False, reason=f"info op f={e.f!r} not supported")
+            return Packed(ok=False, reason=f"info op f={ef!r} not supported")
     # symmetry reduction: info ops with identical (f, a1, a2) are
     # interchangeable, and a lower-npred member is enabled whenever a
     # higher-npred one is, so any linearization can be rewritten to fire

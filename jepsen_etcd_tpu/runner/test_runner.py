@@ -72,7 +72,6 @@ def check_task_leaks(loop, where: str = "post-run") -> None:
     """Scan the SimLoop for live client tasks and throw, like the
     reference's pre-run sshj thread-leak scan (support.clj:57-72 throws
     :sshj-thread-leak with the offending stacks)."""
-    from ..sut.errors import SimError
     leaked = [t.name for t in loop.tasks
               if not t.done and t.name.startswith(_CLIENT_TASK_PREFIXES)]
     if leaked:
@@ -129,11 +128,13 @@ def run_test(test: dict) -> dict:
             if nemesis_obj is not None:
                 await nemesis_obj.teardown(test)
             await db.teardown(test)
-            # grace: let closed clients' pumps observe closure, timed-out
-            # rpcs cancel (5 s client timeout), then scan for leaked
-            # client tasks
+            # grace: let closed clients' pumps observe closure and
+            # timed-out rpcs cancel before the leak scan — derived from
+            # the client timeout so raising TIMEOUT can't cause
+            # spurious task-leak reports
             from .sim import sleep, SECOND
-            await sleep(6 * SECOND)
+            from ..client.base import TIMEOUT
+            await sleep(TIMEOUT + 1 * SECOND)
             return h
 
         history = loop.run_coro(main())

@@ -31,7 +31,7 @@ from ..core.op import Op
 from ..client import with_errors
 from ..client import txn as t
 from ..checkers import compose, TimelineHtml
-from ..checkers.linearizable import LinearizableChecker
+from ..checkers.tpu_linearizable import TPULinearizableChecker
 from ..checkers.set_full import SetFull
 from ..generators import mix
 from ..models import Mutex
@@ -216,7 +216,9 @@ def workload(opts: dict) -> dict:
     return {
         "client": LinearizableLockClient(),
         "checker": compose({
-            "linear": LinearizableChecker(Mutex),
+            # mutex packs onto the TPU WGL kernel via the CAS-register
+            # adapter (ops/wgl.py mutex_adapter); CPU oracle on fallback
+            "linear": TPULinearizableChecker(Mutex),
             "timeline": TimelineHtml(),
         }),
         "generator": mix([acquires, releases]),
