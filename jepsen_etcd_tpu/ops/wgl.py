@@ -46,7 +46,10 @@ import numpy as np
 
 from ..checkers.linearizable import Entry, history_entries
 
-W = 32          # window width (max undecided concurrent required ops)
+W = 32          # single-word window width (fast path)
+W_MAX = 64      # two-word window width (high-overlap histories: long
+                # blocked ops — e.g. lock acquires — spanning many
+                # completions push the undecided window past 32)
 I_MAX = 32      # info-op capacity (one uint32 mask word)
 F_MAX = 512     # frontier capacity per wave (in-kernel mode)
 SENTINEL_D = np.int32(2 ** 31 - 1)
@@ -66,6 +69,14 @@ SPILL_FRONTIER_LIMIT = 400_000
 SPILL_STATE_BUDGET = 3_000_000
 
 
+def split_words(m64: np.ndarray, nw: int) -> np.ndarray:
+    """Split uint64 masks into nw little-endian uint32 words (new
+    trailing axis)."""
+    lo = (m64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (m64 >> np.uint64(32)).astype(np.uint32)
+    return np.stack([lo, hi], axis=-1)[..., :nw]
+
+
 @dataclass
 class Packed:
     """Host-packed tables for one key's history."""
@@ -75,6 +86,7 @@ class Packed:
     R: int = 0
     I: int = 0
     n_values: int = 0
+    w: int = W      # window width (32 single-word / 64 two-word)
     # required tables: [R, W] unless noted
     shift: Any = None         # [R] int32
     static_ok: Any = None     # [R, W] bool
@@ -109,14 +121,14 @@ def mutex_adapter(f: str, value):
     return None
 
 
-def pack_mutex_history(history, w: int = W, i_max: int = I_MAX) -> Packed:
+def pack_mutex_history(history, i_max: int = I_MAX) -> Packed:
     """Pack a mutex (acquire/release) history for the kernel."""
-    return pack_register_history(history, w=w, i_max=i_max,
+    return pack_register_history(history, i_max=i_max,
                                  adapter=mutex_adapter)
 
 
 def pack_register_history(history, value_ids: Optional[dict] = None,
-                          w: int = W, i_max: int = I_MAX,
+                          i_max: int = I_MAX,
                           adapter=None) -> Packed:
     """Build the per-depth tables for the kernel. Returns ok=False with a
     reason when the history needs the CPU path. ``adapter`` (optional)
@@ -251,14 +263,19 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
         while p < R and cap[p] < d:
             p += 1
         lo[d] = p
-    # feasibility: window must hold all set bits and all enabled candidates
+    # feasibility: window must hold all set bits and all enabled
+    # candidates. Histories needing >32 bits get the two-word (W=64)
+    # kernel variant; >64 is beyond the kernel.
     width_bits = np.max(np.arange(R + 1) - lo) if R else 0
     first_lo = lo[np.minimum(pred, R)]
     width_cand = np.max(np.arange(R) - first_lo) + 1 if R else 0
-    if max(width_bits, width_cand) > w:
+    width = max(width_bits, width_cand)
+    if width > W_MAX:
         return Packed(ok=False,
-                      reason=f"window {max(width_bits, width_cand)} > {w} "
+                      reason=f"window {width} > {W_MAX} "
                              f"(concurrency too high for kernel)")
+    w = W if width <= W else W_MAX
+    nw = w // 32
 
     d_idx = np.arange(R)[:, None]                       # [R, 1]
     b_idx = np.arange(w)[None, :]                       # [1, W]
@@ -266,17 +283,21 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
     in_range = (lo[:R][:, None] + b_idx) < R
     static_ok = in_range & (pred[idx] <= d_idx)
 
-    # predecessor bits within the frame: bit c <-> rank lo[d]+c
+    # predecessor bits within the frame: bit c <-> rank lo[d]+c. Masks
+    # build as uint64 then split into nw little-endian uint32 words
+    # (trailing axis) — TPUs have no native 64-bit ints.
     ret_frame = ret[idx]                                      # [R, W]
     inv_cand = inv[idx]                                       # [R, W]
     is_pred = (ret_frame[:, None, :] < inv_cand[:, :, None])  # [R, W, W]
     in_range_c = in_range[:, None, :]                         # [R, 1, W]
     bits = (1 << np.arange(w, dtype=np.uint64))
-    pred_frame = ((is_pred & in_range_c) * bits).sum(-1).astype(np.uint32)
+    pred_frame = split_words(
+        ((is_pred & in_range_c) * bits).sum(-1, dtype=np.uint64), nw)
 
     is_upd = (f == WRITE) | (f == CAS)
     upd_frame = is_upd[idx] & in_range
-    upd_mask = (upd_frame * bits).sum(-1).astype(np.uint32)
+    upd_mask = split_words(
+        (upd_frame * bits).sum(-1, dtype=np.uint64), nw)
     cum_upd = np.concatenate([[0], np.cumsum(is_upd)])
     u_forced = cum_upd[lo[:R]].astype(np.int32)
 
@@ -287,19 +308,20 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
     if I:
         pred_in_win = in_range[:, :, None] & \
             (ret_frame[:, :, None] < i_inv[None, None, :])    # [R, W, I]
-        ipred_frame = (pred_in_win * bits[None, :, None]).sum(1) \
-            .astype(np.uint32)                                # [R, I]
+        ipred_frame = split_words(
+            (pred_in_win * bits[None, :, None]).sum(
+                1, dtype=np.uint64), nw)                      # [R, I, NW]
         pf = (ret[:, None] < i_inv[None, :])                  # [R, I]
         C = np.concatenate([np.zeros((1, I), dtype=np.int64),
                             np.cumsum(pf, axis=0)])           # [R+1, I]
         hi = np.minimum(lo[:R] + w, R)                        # [R]
         i_static_ok = C[hi] == C[R][None, :]                  # [R, I]
     else:
-        ipred_frame = np.zeros((R, 0), dtype=np.uint32)
+        ipred_frame = np.zeros((R, 0, nw), dtype=np.uint32)
         i_static_ok = np.zeros((R, 0), dtype=bool)
 
     return Packed(
-        ok=True, R=R, I=I, n_values=len(vid) + 1,
+        ok=True, R=R, I=I, n_values=len(vid) + 1, w=w,
         shift=(lo[1:] - lo[:-1]).astype(np.int32),
         static_ok=static_ok,
         f_code=f[idx].astype(np.int8),
@@ -327,8 +349,14 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
     from jax import lax
 
     f_in = dvec.shape[0]
-    bpos = jnp.arange(w, dtype=jnp.uint32)[None, :]        # [1, W]
-    bit = (jnp.uint32(1) << bpos)
+    nw = wvec.shape[1]                 # mask words (1: W<=32, 2: W<=64)
+    # static one-hot candidate-bit table: B[b, wi] = bit (b%32) of word
+    # b//32 — little-endian words, same layout split_words produces
+    B_np = np.zeros((w, nw), dtype=np.uint32)
+    for b in range(w):
+        B_np[b, b // 32] = np.uint32(1) << np.uint32(b % 32)
+    B = jnp.asarray(B_np)                                  # [W, NW]
+
     alive = (dvec != SENTINEL_D) & (dvec < R)              # [F]
     d_cl = jnp.clip(dvec, 0, tables["shift"].shape[0] - 1)
     row = lambda t: jnp.take(t, d_cl, axis=0)              # [F, ...]
@@ -338,15 +366,17 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
     ra1 = row(tables["a1"])
     ra2 = row(tables["a2"])
     rver = row(tables["ver"])
-    rpred = row(tables["pred_frame"])
-    rupd = row(tables["upd_mask"])                         # [F]
+    rpred = row(tables["pred_frame"])                      # [F, W, NW]
+    rupd = row(tables["upd_mask"])                         # [F, NW]
     ruf = row(tables["u_forced"])                          # [F]
     rshift = row(tables["shift"]).astype(jnp.uint32)       # [F]
 
-    wm = wvec[:, None]                                     # [F, 1]
-    not_set = ((wm >> bpos) & 1) == 0
-    preds_in = (wm & rpred) == rpred
-    version = (ruf + lax.population_count(wvec & rupd).astype(jnp.int32)
+    wm = wvec[:, None, :]                                  # [F, 1, NW]
+    not_set = ~jnp.any((wm & B[None]) != 0, axis=-1)       # [F, W]
+    preds_in = jnp.all((wm & rpred) == rpred, axis=-1)     # [F, W]
+    version = (ruf
+               + lax.population_count(wvec & rupd)
+               .sum(axis=-1).astype(jnp.int32)
                + lax.population_count(ivec).astype(jnp.int32))  # [F]
     ver_b = version[:, None]
     v = vvec[:, None]                                      # [F, 1]
@@ -363,24 +393,53 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
     model_ok = read_ok | is_write | cas_ok
     req_valid = alive[:, None] & s_ok & not_set & preds_in & ver_ok & model_ok
 
-    new_w = wm | bit                                       # [F, W]
-    # shift may equal w (whole window forced at once); uint32 << 32
-    # is implementation-defined, so saturate explicitly
-    rshift_b = rshift[:, None]
-    full_slide = rshift_b >= jnp.uint32(w)
-    low_mask = jnp.where(full_slide, jnp.uint32(0xFFFFFFFF),
-                         (jnp.uint32(1) << rshift_b) - jnp.uint32(1))
-    slide_ok = (new_w & low_mask) == low_mask
+    new_w = wm | B[None]                                   # [F, W, NW]
+    # slide feasibility: the rshift lowest bits (which fall off the
+    # window) must all be set. Per-word low masks; shift amounts are
+    # clamped before any << / >> so no lane shifts by >= 32 (UB).
+    s_amt = rshift[:, None]                                # [F, 1]
+
+    def low_mask_word(wi):
+        k = jnp.clip(s_amt.astype(jnp.int32) - 32 * wi, 0, 32)
+        ksafe = jnp.minimum(k, 31).astype(jnp.uint32)
+        return jnp.where(k >= 32, jnp.uint32(0xFFFFFFFF),
+                         (jnp.uint32(1) << ksafe) - jnp.uint32(1))
+
+    low = jnp.stack([low_mask_word(wi) for wi in range(nw)],
+                    axis=-1)                               # [F, 1, NW]
+    slide_ok = jnp.all((new_w & low) == low, axis=-1)      # [F, W]
     req_valid = req_valid & slide_ok
-    new_w = jnp.where(full_slide, jnp.uint32(0), new_w >> rshift_b)
+
+    def rshift_words(words, s):
+        """words: list of NW [..., ] uint32 planes; s broadcastable
+        shift in [0, 32*nw]. Returns the shifted planes."""
+        s32 = s.astype(jnp.uint32)
+        ssafe = jnp.minimum(s32, jnp.uint32(31))
+        if nw == 1:
+            return [jnp.where(s32 >= 32, jnp.uint32(0),
+                              words[0] >> ssafe)]
+        w0, w1 = words
+        s2 = jnp.where(s32 >= 32, s32 - 32, jnp.uint32(0))
+        s2safe = jnp.minimum(s2, jnp.uint32(31))
+        carry = jnp.where(ssafe == jnp.uint32(0), jnp.uint32(0),
+                          w1 << (jnp.uint32(32) - ssafe))
+        lo_small = (w0 >> ssafe) | carry
+        lo_big = jnp.where(s2 >= 32, jnp.uint32(0), w1 >> s2safe)
+        out0 = jnp.where(s32 >= 32, lo_big, lo_small)
+        out1 = jnp.where(s32 >= 32, jnp.uint32(0), w1 >> ssafe)
+        return [out0, out1]
+
+    shifted = rshift_words([new_w[:, :, wi] for wi in range(nw)], s_amt)
+    new_w = jnp.stack(shifted, axis=-1)                    # [F, W, NW]
     req_d = jnp.broadcast_to(dvec[:, None] + 1, (f_in, w))
     req_i = jnp.broadcast_to(ivec[:, None], (f_in, w))
     req_v = jnp.where(is_read, v,
                       jnp.where(is_write, ra1, ra2)).astype(jnp.int32)
     accepted = jnp.any(req_valid & (req_d == R))
 
+    rv3 = req_valid[:, :, None]
     cand_d = [jnp.where(req_valid, req_d, SENTINEL_D)]
-    cand_w = [jnp.where(req_valid, new_w, jnp.uint32(SENTINEL_W))]
+    cand_w = [jnp.where(rv3, new_w, jnp.uint32(SENTINEL_W))]
     cand_i = [req_i]
     cand_v = [jnp.where(req_valid, req_v, SENTINEL_V)]
 
@@ -390,8 +449,8 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
         im = ivec[:, None]
         ibit_clear = ((im >> iarange) & 1) == 0
         istat = row(tables["i_static_ok"])                 # [F, I]
-        ipredf = row(tables["ipred_frame"])                # [F, I]
-        ipred_in = (wm & ipredf) == ipredf
+        ipredf = row(tables["ipred_frame"])                # [F, I, NW]
+        ipred_in = jnp.all((wm & ipredf) == ipredf, axis=-1)
         ifc = tables["i_f"][None, :]
         ia1 = tables["i_a1"][None, :]
         ia2 = tables["i_a2"][None, :]
@@ -406,32 +465,38 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
         i_new_v = jnp.broadcast_to(i_new_v, (f_in, i_pad))
         cand_d.append(jnp.where(i_valid, jnp.broadcast_to(
             dvec[:, None], (f_in, i_pad)), SENTINEL_D))
-        cand_w.append(jnp.where(i_valid, jnp.broadcast_to(
-            wvec[:, None], (f_in, i_pad)), jnp.uint32(SENTINEL_W)))
+        cand_w.append(jnp.where(
+            i_valid[:, :, None],
+            jnp.broadcast_to(wvec[:, None, :], (f_in, i_pad, nw)),
+            jnp.uint32(SENTINEL_W)))
         cand_i.append(i_new_i)
         cand_v.append(jnp.where(i_valid, i_new_v, SENTINEL_V))
 
     flat_d = jnp.concatenate(cand_d, axis=1).reshape(-1)
-    flat_w = jnp.concatenate(cand_w, axis=1).reshape(-1)
+    flat_w = jnp.concatenate(cand_w, axis=1).reshape(-1, nw)
     flat_i = jnp.concatenate(cand_i, axis=1).reshape(-1)
     flat_v = jnp.concatenate(cand_v, axis=1).reshape(-1)
 
-    sd, sw, si, sv = lax.sort((flat_d, flat_w, flat_i, flat_v), num_keys=4)
+    ops = (flat_d, *[flat_w[:, wi] for wi in range(nw)], flat_i, flat_v)
+    sorted_ = lax.sort(ops, num_keys=len(ops))
+    sd = sorted_[0]
+    sw = list(sorted_[1:1 + nw])
+    si, sv = sorted_[1 + nw], sorted_[2 + nw]
     is_real = sd != SENTINEL_D
-    first = jnp.concatenate([
-        jnp.array([True]),
-        (sd[1:] != sd[:-1]) | (sw[1:] != sw[:-1])
-        | (si[1:] != si[:-1]) | (sv[1:] != sv[:-1])])
+    change = (sd[1:] != sd[:-1]) | (si[1:] != si[:-1]) | (sv[1:] != sv[:-1])
+    for wi in range(nw):
+        change = change | (sw[wi][1:] != sw[wi][:-1])
+    first = jnp.concatenate([jnp.array([True]), change])
     uniq = is_real & first
     pos = jnp.cumsum(uniq.astype(jnp.int32)) - 1
     n_new = jnp.sum(uniq.astype(jnp.int32))
     pos = jnp.where(uniq & (pos < f_out), pos, f_out)      # drop overflowed
     out_d = jnp.full((f_out + 1,), SENTINEL_D, dtype=jnp.int32)
-    out_w = jnp.full((f_out + 1,), SENTINEL_W, dtype=jnp.uint32)
+    out_w = jnp.full((f_out + 1, nw), SENTINEL_W, dtype=jnp.uint32)
     out_i = jnp.full((f_out + 1,), jnp.uint32(0), dtype=jnp.uint32)
     out_v = jnp.full((f_out + 1,), SENTINEL_V, dtype=jnp.int32)
     out_d = out_d.at[pos].set(sd, mode="drop")[:f_out]
-    out_w = out_w.at[pos].set(sw, mode="drop")[:f_out]
+    out_w = out_w.at[pos].set(jnp.stack(sw, axis=-1), mode="drop")[:f_out]
     out_i = out_i.at[pos].set(si, mode="drop")[:f_out]
     out_v = out_v.at[pos].set(sv, mode="drop")[:f_out]
     return out_d, out_w, out_i, out_v, n_new, accepted
@@ -479,9 +544,10 @@ def _wgl_kernel(tables: dict, R, I, f_max: int = F_MAX, w: int = W,
         k, _, _, _, _, n_alive, overflow, accepted, _ = carry
         return (~accepted) & (n_alive > 0) & (~overflow) & (k < R + I + 1)
 
+    nw = w // 32
     d0 = jnp.full((f_max,), SENTINEL_D, dtype=jnp.int32)
     d0 = d0.at[0].set(0)
-    w0 = jnp.full((f_max,), SENTINEL_W, dtype=jnp.uint32)
+    w0 = jnp.full((f_max, nw), SENTINEL_W, dtype=jnp.uint32)
     w0 = w0.at[0].set(0)
     i0 = jnp.zeros((f_max,), dtype=jnp.uint32)
     v0 = jnp.full((f_max,), SENTINEL_V, dtype=jnp.int32)
@@ -529,7 +595,7 @@ def pad_tables(p: Packed, r_pad: int, i_pad: int = None):
         return out
 
     def padded_ri(a):
-        out = np.zeros((r_pad, i_pad), dtype=a.dtype)
+        out = np.zeros((r_pad, i_pad) + a.shape[2:], dtype=a.dtype)
         out[:a.shape[0], :p.I] = a
         return out
 
@@ -578,15 +644,17 @@ def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
     import jax.numpy as jnp
 
     i_pad = bucket_i(p.I)
+    nw = p.w // 32
     f_in = SPILL_CHUNK
-    f_out = f_in * (W + max(i_pad, 1))
-    expand = _expand_jitted(f_in, W, i_pad, f_out)
+    f_out = f_in * (p.w + max(i_pad, 1))
+    expand = _expand_jitted(f_in, p.w, i_pad, f_out)
     dvec, wvec, ivec, vvec, n_alive = [np.asarray(x) for x in frontier]
     n = int(n_alive)
-    fr = np.stack([dvec[:n].astype(np.int64),
-                   wvec[:n].astype(np.int64),
-                   ivec[:n].astype(np.int64),
-                   vvec[:n].astype(np.int64)], axis=1)
+    fr = np.concatenate(
+        [dvec[:n, None].astype(np.int64),
+         wvec[:n].astype(np.int64).reshape(n, nw),
+         ivec[:n, None].astype(np.int64),
+         vvec[:n, None].astype(np.int64)], axis=1)  # [n, 3 + nw]
     states_total = n
     peak = n
     waves = waves_done
@@ -597,13 +665,13 @@ def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
             chunk = fr[s:s + f_in]
             cn = chunk.shape[0]
             cd = np.full(f_in, SENTINEL_D, dtype=np.int32)
-            cw = np.full(f_in, SENTINEL_W, dtype=np.uint32)
+            cw = np.full((f_in, nw), SENTINEL_W, dtype=np.uint32)
             ci = np.zeros(f_in, dtype=np.uint32)
             cv = np.full(f_in, SENTINEL_V, dtype=np.int32)
             cd[:cn] = chunk[:, 0]
-            cw[:cn] = chunk[:, 1].astype(np.uint32)
-            ci[:cn] = chunk[:, 2].astype(np.uint32)
-            cv[:cn] = chunk[:, 3]
+            cw[:cn] = chunk[:, 1:1 + nw].astype(np.uint32)
+            ci[:cn] = chunk[:, 1 + nw].astype(np.uint32)
+            cv[:cn] = chunk[:, 2 + nw]
             out_d, out_w, out_i, out_v, n_new, accepted = expand(
                 jnp.asarray(cd), jnp.asarray(cw), jnp.asarray(ci),
                 jnp.asarray(cv), tables, jnp.int32(p.R), jnp.int32(p.I))
@@ -614,13 +682,13 @@ def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
                         "states": states_total}
             m = int(n_new)
             if m:
-                succs.append(np.stack(
-                    [np.asarray(out_d)[:m].astype(np.int64),
+                succs.append(np.concatenate(
+                    [np.asarray(out_d)[:m, None].astype(np.int64),
                      np.asarray(out_w)[:m].astype(np.int64),
-                     np.asarray(out_i)[:m].astype(np.int64),
-                     np.asarray(out_v)[:m].astype(np.int64)], axis=1))
+                     np.asarray(out_i)[:m, None].astype(np.int64),
+                     np.asarray(out_v)[:m, None].astype(np.int64)], axis=1))
         if not succs:
-            fr = np.zeros((0, 4), dtype=np.int64)
+            fr = np.zeros((0, 3 + nw), dtype=np.int64)
             break
         fr = np.unique(np.concatenate(succs, axis=0), axis=0)
         waves += 1
@@ -679,14 +747,15 @@ def check_packed_batch(packs: list, f_max: Optional[int] = None) -> list:
         elif p.R == 0:
             results[i] = {"valid?": True, "waves": 0}
         else:
-            groups.setdefault((bucket(p.R), bucket_i(p.I)), []).append(i)
-    for (r_pad, i_pad), idxs in groups.items():
-        _check_bucket_group(packs, results, idxs, r_pad, i_pad, f_max)
+            groups.setdefault((bucket(p.R), bucket_i(p.I), p.w),
+                              []).append(i)
+    for (r_pad, i_pad, w), idxs in groups.items():
+        _check_bucket_group(packs, results, idxs, r_pad, i_pad, w, f_max)
     return results
 
 
 def _check_bucket_group(packs: list, results: list, idxs: list,
-                        r_pad: int, i_pad: int,
+                        r_pad: int, i_pad: int, w: int,
                         f_max: Optional[int]) -> None:
     """One vmapped launch for a same-bucket key group; results written
     in place."""
@@ -727,7 +796,7 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
         put = jnp.asarray
     tables_dev = {k: put(v) for k, v in stacked.items()}
     valid, overflow, waves, peak, _frontier = _batched_kernel_jitted(
-        f_max, W, i_pad)(tables_dev, put(Rs), put(Is))
+        f_max, w, i_pad)(tables_dev, put(Rs), put(Is))
     valid = np.asarray(valid)
     overflow = np.asarray(overflow)
     waves = np.asarray(waves)
@@ -766,7 +835,7 @@ def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
     i_pad = bucket_i(p.I)
     tables = {k: jnp.asarray(v)
               for k, v in pad_tables(p, bucket(p.R), i_pad).items()}
-    valid, overflow, k, peak, frontier = _kernel_jitted(f_max, W, i_pad)(
+    valid, overflow, k, peak, frontier = _kernel_jitted(f_max, p.w, i_pad)(
         tables, jnp.int32(p.R), jnp.int32(p.I))
     valid = bool(valid)
     overflow = bool(overflow)

@@ -403,6 +403,15 @@ def gen_mutex_history(rng, n_procs=3, n_ops=24, corrupt=False,
         else:
             locked = False
             outcomes[i] = ("release", "ok")
+    if corrupt:
+        # flip some outcomes BEFORE events are emitted: checkers take f
+        # from the invoke op, so a flip must land there to produce a
+        # genuinely illegal schedule (double-acquire / free-release)
+        for i in list(outcomes):
+            if outcomes[i][1] == "ok" and rng.random() < 0.2:
+                f0, res = outcomes[i]
+                outcomes[i] = ("release" if f0 == "acquire"
+                               else "acquire", res)
     evs = []
     for i, (s, e, p) in enumerate(spans):
         evs.append((s, "inv", i, p))
@@ -411,10 +420,6 @@ def gen_mutex_history(rng, n_procs=3, n_ops=24, corrupt=False,
     ops = []
     for _, kind, i, p in evs:
         f, res = outcomes[i]
-        if corrupt and kind == "ret" and res == "ok" \
-                and rng.random() < 0.2:
-            f = "release" if f == "acquire" else "acquire"
-            outcomes[i] = (f, res)
         if kind == "inv":
             ops.append(Op(type="invoke", process=p, f=f, value=None))
         elif is_info[i]:
@@ -463,3 +468,64 @@ def test_mutex_known_bad():
         {}, History(ops))
     assert out["valid?"] is False
     assert out["checker"] == "tpu-wgl"
+
+
+def _wide_window_history(n=45, bad=False):
+    """One write spans n sequential versioned writes: the undecided
+    window reaches n+1 > 32, exercising the two-word (W=64) kernel."""
+    ops = [Op(type="invoke", process=0, f="write", value=[None, 7])]
+    for i in range(1, n + 1):
+        ops.append(Op(type="invoke", process=i, f="write",
+                      value=[None, i]))
+        ver = i if not (bad and i == n) else i + 3
+        ops.append(Op(type="ok", process=i, f="write", value=[ver, i]))
+    ops.append(Op(type="ok", process=0, f="write", value=[n + 1, 7]))
+    return History(ops)
+
+
+def test_wide_window_uses_w64():
+    p = wgl.pack_register_history(_wide_window_history(45))
+    assert p.ok and p.w == 64, (p.ok, p.reason, p.w)
+    out = TPULinearizableChecker(fallback=False).check(
+        {}, _wide_window_history(45))
+    assert out["valid?"] is True, out
+    assert out["checker"] == "tpu-wgl"
+
+
+def test_wide_window_invalid():
+    out = TPULinearizableChecker(fallback=False).check(
+        {}, _wide_window_history(45, bad=True))
+    assert out["valid?"] is False, out
+
+
+def test_window_past_64_rejected():
+    p = wgl.pack_register_history(_wide_window_history(70))
+    assert not p.ok and "window" in p.reason
+
+
+def test_differential_wide_histories():
+    """Random histories stretched by a history-spanning op (window > 32)
+    agree with the CPU oracle on the W=64 kernel."""
+    rng = random.Random(321)
+    checker = TPULinearizableChecker(fallback=False)
+    definitive = 0
+    for trial in range(30):
+        base = gen_history(rng, n_procs=3, n_ops=rng.randint(34, 50),
+                           corrupt=(trial % 2 == 1))
+        long_op = Op(type="invoke", process=99, f="write",
+                     value=[None, 3])
+        ops = [long_op] + list(base) + [
+            Op(type="ok", process=99, f="write", value=[None, 3])]
+        h = History([o.evolve(index=None) for o in ops])
+        p = wgl.pack_register_history(h)
+        if not p.ok:
+            continue
+        cpu = check_history(VersionedRegister(), h)
+        tpu = checker.check({}, h)
+        if tpu["valid?"] == "unknown" or cpu["valid?"] == "unknown":
+            continue
+        definitive += 1
+        assert tpu["valid?"] == cpu["valid?"], (
+            f"trial {trial} (w={p.w}): kernel={tpu} "
+            f"oracle={cpu['valid?']}\n" + h.to_jsonl())
+    assert definitive >= 20, f"only {definitive}/30 definitive"
