@@ -1,13 +1,15 @@
 #!/usr/bin/env python
-"""The headline benchmark (BASELINE.md north star).
+"""The benchmark suite (BASELINE.md configs 1-5).
 
-Generates a 10k-op single-key register history with the hermetic
-simulator (seeded, concurrency 8), then times the TPU linearizability
-kernel verifying it. Baseline: the reference's CPU Knossos checker cannot
-verify a 10k-op single-key history within 60 s (it times out; BASELINE.md
-"North star"), so vs_baseline = 60s / our wall-clock.
+Headline (north star): a 10k-op single-key register history verified
+linearizable on TPU; the reference's CPU Knossos cannot verify it within
+60 s (BASELINE.md "North star"), so vs_baseline = 60s / wall-clock.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"matrix": {...}} — the matrix carries BASELINE.md's other configs
+(register-100 CPU-vs-TPU, deep WGL at 4n/2000, set-full, Elle append at
+device-closure scale, watch edit-distance), each with wall-clock and
+search stats (peak frontier, spill, device usage).
 """
 
 import json
@@ -21,8 +23,8 @@ CONCURRENCY = 8
 BASELINE_SECONDS = 60.0  # CPU Knossos budget it cannot meet
 
 
-def generate_history(n_ops: int = N_OPS, seed: int = 2026):
-    """10k ops on ONE key via the simulated cluster (fast: virtual time)."""
+def sim_register_history(n_ops, concurrency, seed=2026, name="bench"):
+    """n_ops on ONE key via the simulated cluster (fast: virtual time)."""
     from jepsen_etcd_tpu.compose import etcd_test
     from jepsen_etcd_tpu.runner.test_runner import run_test
     from jepsen_etcd_tpu.generators import limit, mix, reserve, independent
@@ -33,54 +35,170 @@ def generate_history(n_ops: int = N_OPS, seed: int = 2026):
     test = etcd_test({
         "workload": "none",
         "time_limit": 3600, "rate": 0, "seed": seed,
-        "concurrency": CONCURRENCY, "store_base": "store",
+        "concurrency": concurrency, "store_base": "store",
     })
-    test["name"] = "bench-register-10k"
+    test["name"] = name
     test["client"] = RegisterClient()
     test["checker"] = Noop()
     test["generator"] = independent.concurrent_generator(
-        CONCURRENCY, [0],
-        lambda k: limit(n_ops, reserve(CONCURRENCY // 2, r, mix([w, cas]))))
+        concurrency, [0],
+        lambda k: limit(n_ops, reserve(concurrency // 2, r, mix([w, cas]))))
     out = run_test(test)
     from jepsen_etcd_tpu.generators.independent import subhistory
     from jepsen_etcd_tpu.core.history import History
     return History(subhistory(out["history"], 0))
 
 
-def main() -> int:
-    t0 = time.time()
-    h = generate_history()
-    gen_s = time.time() - t0
-    n_ok = len([o for o in h if o.is_ok])
-    print(f"# generated {len(h)} ops ({n_ok} ok) in {gen_s:.1f}s",
-          file=sys.stderr)
+def run_workload(workload, seed=7, time_limit=40, rate=200, **opts):
+    from jepsen_etcd_tpu.compose import etcd_test
+    from jepsen_etcd_tpu.runner.test_runner import run_test
+    o = {"workload": workload, "time_limit": time_limit, "rate": rate,
+         "seed": seed, "store_base": "store"}
+    o.update(opts)
+    test = etcd_test(o)
+    return test, run_test(test)
 
+
+def note(msg):
+    print(f"# {msg}", file=sys.stderr)
+
+
+def bench_register_10k():
+    """North star: 10k-op single-key check (config #1's big sibling)."""
     from jepsen_etcd_tpu.ops import wgl
+    t0 = time.time()
+    h = sim_register_history(N_OPS, CONCURRENCY, name="bench-register-10k")
+    note(f"10k: generated {len(h)} ops in {time.time()-t0:.1f}s")
     p = wgl.pack_register_history(h)
-    if not p.ok:
-        print(f"# pack failed: {p.reason}", file=sys.stderr)
-        return 1
-    print(f"# packed R={p.R}", file=sys.stderr)
-
-    # warmup: first call compiles and runs the full search; the timed
-    # second call measures steady-state search wall-clock
-    wgl.check_packed(p)
+    assert p.ok, p.reason
+    wgl.check_packed(p)  # warmup: compile + first search
     t1 = time.time()
     out = wgl.check_packed(p)
-    check_s = time.time() - t1
-    print(f"# kernel verdict={out['valid?']} waves={out.get('waves')} "
-          f"peak-frontier={out.get('peak-frontier')} in {check_s:.3f}s "
-          f"(first call incl. compile: {t1 - t0 - gen_s:.1f}s)",
-          file=sys.stderr)
-    if out["valid?"] is not True:
-        print(f"# UNEXPECTED verdict: {out}", file=sys.stderr)
-        return 1
+    dt = time.time() - t1
+    note(f"10k: verdict={out['valid?']} waves={out.get('waves')} "
+         f"peak={out.get('peak-frontier')} w={p.w} in {dt:.3f}s")
+    assert out["valid?"] is True, out
+    return dt, out, p
 
+
+def bench_register_100():
+    """Config #1: 1 key, ops-per-key 100 — the regime the reference's
+    CPU Knossos competes in; report CPU oracle vs TPU kernel."""
+    from jepsen_etcd_tpu.ops import wgl
+    from jepsen_etcd_tpu.checkers.linearizable import check_history
+    from jepsen_etcd_tpu.models import VersionedRegister
+    h = sim_register_history(135, CONCURRENCY, seed=11,
+                             name="bench-register-100")
+    p = wgl.pack_register_history(h)
+    assert p.ok, p.reason
+    t0 = time.time()
+    cpu = check_history(VersionedRegister(), h)
+    cpu_s = time.time() - t0
+    wgl.check_packed(p)
+    t1 = time.time()
+    tpu = wgl.check_packed(p)
+    tpu_s = time.time() - t1
+    assert tpu["valid?"] is True and cpu["valid?"] is True
+    note(f"100-op: cpu={cpu_s:.4f}s tpu={tpu_s:.4f}s")
+    return {"value": round(tpu_s, 4), "unit": "s",
+            "cpu_oracle_s": round(cpu_s, 4),
+            "ops": p.R, "vs_baseline": round(BASELINE_SECONDS / max(
+                tpu_s, 1e-9), 1)}
+
+
+def bench_deep_wgl():
+    """Config #2: concurrency 4n (=20), ops-per-key 2000 — deep
+    permutation search; records peak frontier + spill stats."""
+    from jepsen_etcd_tpu.ops import wgl
+    h = sim_register_history(2600, 20, seed=5, name="bench-register-deep")
+    p = wgl.pack_register_history(h)
+    assert p.ok, p.reason
+    # deep searches overflow the 128 rung immediately; start at 512 to
+    # skip one heavy w=64 compile in the warmup
+    wgl.check_packed(p, f_max=wgl.F_MAX)
+    t0 = time.time()
+    out = wgl.check_packed(p, f_max=wgl.F_MAX)
+    dt = time.time() - t0
+    note(f"deep 4n/2000: verdict={out['valid?']} w={p.w} "
+         f"peak={out.get('peak-frontier')} spilled={out.get('spilled')} "
+         f"in {dt:.3f}s")
+    assert out["valid?"] is True, out
+    return {"value": round(dt, 4), "unit": "s", "ops": p.R, "w": p.w,
+            "peak_frontier": out.get("peak-frontier"),
+            "spilled": bool(out.get("spilled")),
+            "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
+
+
+def bench_set():
+    """Config #3: set workload — CAS-retry adds + set-full analysis."""
+    from jepsen_etcd_tpu.checkers.set_full import SetFull
+    test, out = run_workload("set", time_limit=60, rate=200)
+    h = out["history"]
+    t0 = time.time()
+    res = SetFull(linearizable=True).check(test, h)
+    dt = time.time() - t0
+    note(f"set-full: valid?={res['valid?']} over {len(h)} ops in {dt:.3f}s")
+    assert res["valid?"] is True, res
+    return {"value": round(dt, 4), "unit": "s", "history_ops": len(h),
+            "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
+
+
+def bench_elle_append():
+    """Config #4: Elle list-append dep-graph + closure at device scale
+    (>=256 committed txns forces the device closure path)."""
+    from jepsen_etcd_tpu.workloads.append import workload as append_wl
+    test, out = run_workload("append", time_limit=25, rate=200)
+    h = out["history"].client_ops()
+    committed = len([o for o in h if o.is_ok])
+    checker = append_wl({"nodes": test["nodes"]})["checker"]
+    checker.use_tpu = True  # force the device closure regardless of N
+    t0 = time.time()
+    res = checker.check(test, h)
+    dt = time.time() - t0
+    note(f"elle append: valid?={res['valid?']} txns={committed} "
+         f"in {dt:.3f}s (device closure forced)")
+    assert res["valid?"] is True, res
+    return {"value": round(dt, 4), "unit": "s", "committed_txns": committed,
+            "device_closure": True,
+            "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
+
+
+def bench_watch():
+    """Config #5: watch per-thread log order vs canonical (TPU
+    edit-distance)."""
+    from jepsen_etcd_tpu.checkers.watch import WatchChecker
+    test, out = run_workload("watch", time_limit=60, rate=200)
+    h = out["history"]
+    checker = WatchChecker(use_tpu=True)
+    t0 = time.time()
+    res = checker.check(test, h)
+    dt = time.time() - t0
+    note(f"watch: valid?={res['valid?']} in {dt:.3f}s")
+    assert res["valid?"] in (True, "unknown"), res
+    return {"value": round(dt, 4), "unit": "s", "history_ops": len(h),
+            "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
+
+
+def main() -> int:
+    matrix = {}
+    for name, fn in [("register_100", bench_register_100),
+                     ("deep_wgl_4n_2000", bench_deep_wgl),
+                     ("set_full", bench_set),
+                     ("elle_append_device", bench_elle_append),
+                     ("watch_edit_distance", bench_watch)]:
+        try:
+            matrix[name] = fn()
+        except Exception as e:  # record, don't abort the headline bench
+            note(f"{name} FAILED: {e!r}")
+            matrix[name] = {"error": repr(e)}
+
+    check_s, out, p = bench_register_10k()
     print(json.dumps({
         "metric": "register_linearizability_10k_ops_check_wallclock",
         "value": round(check_s, 4),
         "unit": "s",
         "vs_baseline": round(BASELINE_SECONDS / max(check_s, 1e-9), 1),
+        "matrix": matrix,
     }))
     return 0
 

@@ -5,9 +5,11 @@ Replaces Knossos' CPU Wing-Gong/Lowe search (reference binding at
 insight making the search TPU-shaped: in a history with bounded
 concurrency, sort the must-linearize (:ok) ops by invocation; then any
 reachable "linearized set" consists of a *forced prefix* plus a bitmask
-over a sliding window of at most W undecided ops. A search state packs to
+over a sliding window of at most W undecided ops (W auto-selects 32 —
+one uint32 word — or 64 — two words — per history). A search state
+packs to
 
-    (depth d, uint32 window mask, uint32 info mask, model value id)
+    (depth d, window mask words, uint32 info mask, model value id)
 
 and a BFS wave is a dense [F, W + I] tensor expansion:
 - required candidates: window bit clear ∧ precomputed predecessor-mask
@@ -52,6 +54,9 @@ W_MAX = 64      # two-word window width (high-overlap histories: long
                 # completions push the undecided window past 32)
 I_MAX = 32      # info-op capacity (one uint32 mask word)
 F_MAX = 512     # frontier capacity per wave (in-kernel mode)
+F_MAX_BIG = 4096  # top of the in-kernel retry ladder (128->512->4096);
+                # ~1k-frontier searches (e.g. 4n-concurrency register)
+                # stay on-device instead of paying host spill ping-pong
 SENTINEL_D = np.int32(2 ** 31 - 1)
 SENTINEL_W = np.uint32(0xFFFFFFFF)
 SENTINEL_V = np.int32(2 ** 31 - 1)
@@ -87,15 +92,16 @@ class Packed:
     I: int = 0
     n_values: int = 0
     w: int = W      # window width (32 single-word / 64 two-word)
-    # required tables: [R, W] unless noted
+    # required tables ([R, W] unless noted; NW = w // 32 little-endian
+    # uint32 mask words on the trailing axis)
     shift: Any = None         # [R] int32
     static_ok: Any = None     # [R, W] bool
     f_code: Any = None        # [R, W] int8
     a1: Any = None            # [R, W] int32 (read: rval / write: wval / cas: old)
     a2: Any = None            # [R, W] int32 (cas: new)
     ver: Any = None           # [R, W] int32 (version assertion or -1)
-    pred_frame: Any = None    # [R, W] uint32
-    upd_mask: Any = None      # [R] uint32
+    pred_frame: Any = None    # [R, W, NW] uint32
+    upd_mask: Any = None      # [R, NW] uint32
     u_forced: Any = None      # [R] int32
     # info tables
     i_f: Any = None           # [I] int8 (WRITE or CAS)
@@ -103,7 +109,7 @@ class Packed:
     i_a2: Any = None          # [I] int32 (cas new)
     i_class_pred: Any = None  # [I] uint32 (same-class ops that must fire 1st)
     i_static_ok: Any = None   # [R, I] bool (all preds within forced+window)
-    ipred_frame: Any = None   # [R, I] uint32 (window bits that must be set)
+    ipred_frame: Any = None   # [R, I, NW] uint32 (window bits that must be set)
 
 
 MUTEX_LOCKED = "locked"
@@ -421,8 +427,12 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
         w0, w1 = words
         s2 = jnp.where(s32 >= 32, s32 - 32, jnp.uint32(0))
         s2safe = jnp.minimum(s2, jnp.uint32(31))
+        # clamp the carry amount too: 32 - ssafe == 32 when ssafe == 0
+        # (result discarded by the where, but the lane must not shift
+        # by >= 32)
+        carry_amt = jnp.minimum(jnp.uint32(32) - ssafe, jnp.uint32(31))
         carry = jnp.where(ssafe == jnp.uint32(0), jnp.uint32(0),
-                          w1 << (jnp.uint32(32) - ssafe))
+                          w1 << carry_amt)
         lo_small = (w0 >> ssafe) | carry
         lo_big = jnp.where(s2 >= 32, jnp.uint32(0), w1 >> s2safe)
         out0 = jnp.where(s32 >= 32, lo_big, lo_small)
@@ -509,14 +519,31 @@ def _kernel_jitted(f_max: int, w: int, i_pad: int):
                                      i_pad=i_pad))
 
 
+@functools.lru_cache(maxsize=None)
+def _kernel_resume_jitted(f_max: int, w: int, i_pad: int):
+    import jax
+
+    def run(tables, R, I, k0, d0, w0, i0, v0, n0):
+        return _wgl_loop(tables, R, I, f_max, w, i_pad,
+                         (k0, d0, w0, i0, v0, n0))
+
+    return jax.jit(run)
+
+
 def _wgl_kernel(tables: dict, R, I, f_max: int = F_MAX, w: int = W,
                 i_pad: int = 0):
-    """Run the wave loop. tables hold the [R_pad, ...] arrays; R (number
-    of required ops) and I (number of info ops) are dynamic. Returns
-    (valid, overflow, waves_done, frontier_size_max, frontier) where
-    frontier = (dvec, wvec, ivec, vvec, n_alive) is the pre-expansion
-    frontier at exit — on overflow the host spill driver resumes from it.
+    """Run the wave loop from the initial state. tables hold the
+    [R_pad, ...] arrays; R (number of required ops) and I (number of
+    info ops) are dynamic. Returns (valid, overflow, waves_done,
+    frontier_size_max, frontier) where frontier = (dvec, wvec, ivec,
+    vvec, n_alive) is the pre-expansion frontier at exit — on overflow
+    the host driver RESUMES from it at a higher capacity (the retry
+    ladder) or in spill mode, without redoing earlier waves.
     """
+    return _wgl_loop(tables, R, I, f_max, w, i_pad, None)
+
+
+def _wgl_loop(tables: dict, R, I, f_max: int, w: int, i_pad: int, init0):
     import jax.numpy as jnp
     from jax import lax
 
@@ -545,15 +572,19 @@ def _wgl_kernel(tables: dict, R, I, f_max: int = F_MAX, w: int = W,
         return (~accepted) & (n_alive > 0) & (~overflow) & (k < R + I + 1)
 
     nw = w // 32
-    d0 = jnp.full((f_max,), SENTINEL_D, dtype=jnp.int32)
-    d0 = d0.at[0].set(0)
-    w0 = jnp.full((f_max, nw), SENTINEL_W, dtype=jnp.uint32)
-    w0 = w0.at[0].set(0)
-    i0 = jnp.zeros((f_max,), dtype=jnp.uint32)
-    v0 = jnp.full((f_max,), SENTINEL_V, dtype=jnp.int32)
-    v0 = v0.at[0].set(NONE_VAL)
-    init = (jnp.int32(0), d0, w0, i0, v0, jnp.int32(1), jnp.bool_(False),
-            R == 0, jnp.int32(1))
+    if init0 is None:
+        d0 = jnp.full((f_max,), SENTINEL_D, dtype=jnp.int32)
+        d0 = d0.at[0].set(0)
+        w0 = jnp.full((f_max, nw), SENTINEL_W, dtype=jnp.uint32)
+        w0 = w0.at[0].set(0)
+        i0 = jnp.zeros((f_max,), dtype=jnp.uint32)
+        v0 = jnp.full((f_max,), SENTINEL_V, dtype=jnp.int32)
+        v0 = v0.at[0].set(NONE_VAL)
+        k0, n0, peak0 = jnp.int32(0), jnp.int32(1), jnp.int32(1)
+    else:
+        k0, d0, w0, i0, v0, n0 = init0
+        peak0 = n0
+    init = (k0, d0, w0, i0, v0, n0, jnp.bool_(False), R == 0, peak0)
     k, dvec, wvec, ivec, vvec, n_alive, overflow, accepted, peak = \
         lax.while_loop(cond, body, init)
     return (accepted, overflow, k, peak,
@@ -818,9 +849,12 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
 def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
     """Run the kernel on one packed history (host->device->host).
 
-    f_max defaults small for short histories (tiny sorts, fast waves) —
-    an overflow retries at full capacity, then spills to the host-driven
-    chunked BFS rather than giving up.
+    f_max defaults small (tiny sorts, fast waves — healthy frontiers
+    peak in the tens). On overflow the frozen pre-expansion frontier
+    RESUMES at the next capacity rung (512, then 4096) — earlier waves
+    are never redone, and waves only pay for big sorts while the
+    frontier is actually big. Past 4096 the host-driven chunked spill
+    BFS takes over from the same frontier.
     """
     import jax.numpy as jnp
 
@@ -828,22 +862,43 @@ def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
         return {"valid?": "unknown", "reason": p.reason}
     if p.R == 0:
         return {"valid?": True, "waves": 0}
+    # f_max (when given) is the STARTING rung; the ladder still
+    # escalates past it on overflow before spilling
     if f_max is None:
-        # frontiers are tiny on healthy histories (peak ~tens); start
-        # small — sorts are 4x cheaper — and retry at F_MAX on overflow
-        f_max = 128
+        ladder = [128, F_MAX, F_MAX_BIG]
+    else:
+        ladder = [f_max] + [f for f in (F_MAX, F_MAX_BIG) if f > f_max]
     i_pad = bucket_i(p.I)
     tables = {k: jnp.asarray(v)
               for k, v in pad_tables(p, bucket(p.R), i_pad).items()}
-    valid, overflow, k, peak, frontier = _kernel_jitted(f_max, p.w, i_pad)(
-        tables, jnp.int32(p.R), jnp.int32(p.I))
+    R_, I_ = jnp.int32(p.R), jnp.int32(p.I)
+    peak_all = 1
+    valid, overflow, k, peak, frontier = _kernel_jitted(
+        ladder[0], p.w, i_pad)(tables, R_, I_)
+    peak_all = max(peak_all, int(peak))
+    for f_next in ladder[1:]:
+        if not bool(overflow):
+            break
+        # pad the frozen frontier to the next rung and resume in place
+        dvec, wvec, ivec, vvec, n_alive = frontier
+        f_cur = dvec.shape[0]
+        grow = f_next - f_cur
+        d0 = jnp.concatenate([dvec, jnp.full((grow,), SENTINEL_D,
+                                             dtype=jnp.int32)])
+        w0 = jnp.concatenate([wvec, jnp.full((grow, wvec.shape[1]),
+                                             SENTINEL_W,
+                                             dtype=jnp.uint32)])
+        i0 = jnp.concatenate([ivec, jnp.zeros((grow,), dtype=jnp.uint32)])
+        v0 = jnp.concatenate([vvec, jnp.full((grow,), SENTINEL_V,
+                                             dtype=jnp.int32)])
+        valid, overflow, k, peak, frontier = _kernel_resume_jitted(
+            f_next, p.w, i_pad)(tables, R_, I_, k, d0, w0, i0, v0, n_alive)
+        peak_all = max(peak_all, int(peak))
     valid = bool(valid)
-    overflow = bool(overflow)
-    if overflow and f_max < F_MAX:
-        return check_packed(p, f_max=F_MAX)  # retry at full capacity
-    if overflow:
+    if bool(overflow):
         out = _spill_bfs(p, tables, frontier, int(k))
+        out["peak-frontier"] = max(peak_all, out.get("peak-frontier", 0))
         return out
-    return {"valid?": valid, "waves": int(k), "peak-frontier": int(peak),
+    return {"valid?": valid, "waves": int(k), "peak-frontier": peak_all,
             "ops": p.R, "info-ops": p.I,
             **({} if valid else {"stuck-at-depth": int(k)})}
