@@ -32,7 +32,7 @@ the reference checker's ``:worst-stale`` report.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Optional
 
 from ..core.history import History
@@ -46,13 +46,18 @@ class _Element:
     add_type: Optional[str] = None        # ok | fail | info
     known_index: Optional[int] = None     # index where presence is proven
     known_time: Optional[int] = None
-    absent: list = field(default_factory=list)   # reads (index) missing it
     present_after_absent: bool = False
-    last_read_state: Optional[bool] = None       # seen in last covering read
-    stale_until: Optional[int] = None            # time first re-observed
+    stale_until: Optional[int] = None     # time first re-observed
 
 
 def analyze(history) -> dict:
+    """Single forward sweep with set arithmetic.
+
+    Every read covers every element already known when it was invoked,
+    so per-read state is maintained with whole-set operations (C speed)
+    instead of a per-element scan of all reads — the naive formulation
+    is O(elements x reads), quadratic on set-workload histories.
+    """
     h = history if isinstance(history, History) else History(history)
     elements: dict[Any, _Element] = {}
     # reads: (invoke_index, invoke_time, ok_index, value-as-set, dup-list)
@@ -64,7 +69,9 @@ def analyze(history) -> dict:
             continue
         if op.f == "add":
             x = op.value
-            el = elements.setdefault(x, _Element(value=x))
+            el = elements.get(x)
+            if el is None:
+                el = elements[x] = _Element(value=x)
             if op.is_invoke:
                 el.add_invoke = op.index
             else:
@@ -82,7 +89,6 @@ def analyze(history) -> dict:
             reads.append((inv.index if inv is not None else op.index,
                           (inv or op).time or 0, op.index, vset, vals))
 
-    reads.sort()
     # pass 1: establish known points (add :ok completion or first read
     # observation, whichever proves presence earliest in history order)
     for op in h:
@@ -91,28 +97,45 @@ def analyze(history) -> dict:
             if el.known_index is None:
                 el.known_index = op.index
                 el.known_time = op.time or 0
-    for ri, rt, ok_i, vset, _vals in reads:
-        for x in vset:
-            el = elements.setdefault(x, _Element(value=x))
+    observed: set = set()
+    for ri, rt, ok_i, vset, _vals in sorted(reads, key=lambda r: r[2]):
+        for x in vset - observed:   # first observation = min ok_i
+            el = elements.get(x)
+            if el is None:
+                el = elements[x] = _Element(value=x)
             if el.known_index is None or ok_i < el.known_index:
                 el.known_index = ok_i
                 el.known_time = rt
+        observed |= vset
 
-    # pass 2: per element, scan reads invoked after the known point
-    for el in elements.values():
-        if el.known_index is None:
+    # pass 2: sweep reads in invoke order; each read covers exactly the
+    # elements known before its invoke
+    reads.sort()
+    by_known = sorted((el for el in elements.values()
+                       if el.known_index is not None),
+                      key=lambda e: e.known_index)
+    ptr = 0
+    known_now: set = set()
+    absent_last: set = set()       # missing in their latest covering read
+    absent_count: dict[Any, int] = {}
+    for ri, rt, ok_i, vset, _vals in reads:
+        while ptr < len(by_known) and by_known[ptr].known_index < ri:
+            known_now.add(by_known[ptr].value)
+            ptr += 1
+        if not known_now:
             continue
-        for ri, rt, ok_i, vset, _vals in reads:
-            if ri <= el.known_index:
-                continue
-            if el.value in vset:
-                el.last_read_state = True
-                if el.absent and not el.present_after_absent:
-                    el.present_after_absent = True
-                    el.stale_until = rt
-            else:
-                el.absent.append(ri)
-                el.last_read_state = False
+        for x in absent_last & vset:      # reappeared: stale transition
+            el = elements[x]
+            if not el.present_after_absent:
+                el.present_after_absent = True
+                el.stale_until = rt
+        miss = known_now - vset
+        for x in miss:
+            absent_count[x] = absent_count.get(x, 0) + 1
+        absent_last = miss
+    # known_now only grows, so after the sweep it is exactly the set of
+    # elements covered by at least one read
+    covered = known_now
 
     stable, lost, never_read, stale, unknown = [], [], [], [], []
     attempts = 0
@@ -126,11 +149,11 @@ def analyze(history) -> dict:
                 unknown.append(x)        # may never have happened
             # fail: definitely absent; ignore
             continue
-        if el.last_read_state is False:
-            lost.append(x)
-        elif el.absent:
+        if x in absent_last:
+            lost.append(x)               # still missing at the final read
+        elif absent_count.get(x):
             stale.append(x)
-        elif el.last_read_state is None:
+        elif x not in covered:
             never_read.append(x)         # known but no read ever covered it
         else:
             stable.append(x)
@@ -140,7 +163,7 @@ def analyze(history) -> dict:
         el = elements[x]
         dur = (el.stale_until or 0) - (el.known_time or 0)
         worst_stale.append({"element": x, "stale-ns": dur,
-                            "absent-reads": len(el.absent)})
+                            "absent-reads": absent_count.get(x, 0)})
     worst_stale.sort(key=lambda d: -d["stale-ns"])
 
     return {

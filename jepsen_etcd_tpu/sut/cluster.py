@@ -111,9 +111,12 @@ class Node:
         self.match_index: dict[str, int] = {}
         self.lease_expiry: dict[int, int] = {}
         self.waiters: dict[int, tuple[int, Future]] = {}  # index->(term,fut)
-        # durability (bytes on "disk")
-        self.wal_current = b""
-        self.wal_durable = b""
+        # durability (bytes on "disk"); both WAL views are bytearrays so
+        # per-entry appends are amortized O(1) in every fsync config —
+        # rebuilding a bytes object per append made long runs quadratic
+        # in WAL size
+        self._wal_buf = bytearray()
+        self._wal_durable_buf = bytearray()
         self.snap_current = b""
         self.snap_durable = b""
         self.applied_since_snap = 0
@@ -156,11 +159,37 @@ class Node:
 
     # ---- durability -------------------------------------------------------
 
-    def wal_append(self, e: LogEntry) -> None:
-        self.wal_current = walmod.append_record(
-            self.wal_current, (e.index, e.term, e.kind, e.payload))
+    @property
+    def wal_current(self) -> bytes:
+        """The WAL "file" contents (snapshot copy of the live buffer)."""
+        return bytes(self._wal_buf)
+
+    @wal_current.setter
+    def wal_current(self, b: bytes) -> None:
+        self._wal_buf = bytearray(b)
         if not self.cluster.cfg.unsafe_no_fsync:
-            self.wal_durable = self.wal_current
+            # fsync mode: rewrites (conflict truncation, recovery
+            # re-encode) are fsynced like etcd's, keeping the durable
+            # buffer an exact mirror so per-append fast syncs stay valid
+            self._wal_durable_buf = bytearray(b)
+
+    @property
+    def wal_durable(self) -> bytes:
+        return bytes(self._wal_durable_buf)
+
+    @wal_durable.setter
+    def wal_durable(self, b: bytes) -> None:
+        self._wal_durable_buf = bytearray(b)
+
+    @property
+    def wal_size(self) -> int:
+        return len(self._wal_buf)
+
+    def wal_append(self, e: LogEntry) -> None:
+        rec = walmod.record_bytes((e.index, e.term, e.kind, e.payload))
+        self._wal_buf += rec
+        if not self.cluster.cfg.unsafe_no_fsync:
+            self._wal_durable_buf += rec  # fsync-per-append, still O(1)
 
     def fsync(self) -> None:
         self.wal_durable = self.wal_current
@@ -908,7 +937,7 @@ class Cluster:
             "raft-term": n.term,
             "raft-index": n.last_index(),
             "revision": n.store.revision,
-            "db-size": len(n.wal_current) + len(n.snap_current),
+            "db-size": n.wal_size + len(n.snap_current),
             "member-count": len(n.membership),
             "is-leader": n.role == "leader",
         }

@@ -171,14 +171,19 @@ class Store:
                 _, key, value, lease = o
                 prev = self.kvs.get(key)
                 prev_kv = prev.as_kv(key) if prev else None
+                # values are immutable by convention once written (every
+                # client/workload builds fresh containers per put); a
+                # shallow copy guards against top-level reuse without
+                # the O(elements) deepcopy that made big-list workloads
+                # (set: one ever-growing list) quadratic
                 if prev is None:
-                    ks = KeyState(value=copy.deepcopy(value), version=1,
+                    ks = KeyState(value=copy.copy(value), version=1,
                                   create_revision=new_rev,
                                   mod_revision=new_rev, lease=lease)
                 else:
                     if prev.lease and prev.lease != lease:
                         self.lease_keys.get(prev.lease, set()).discard(key)
-                    ks = KeyState(value=copy.deepcopy(value),
+                    ks = KeyState(value=copy.copy(value),
                                   version=prev.version + 1,
                                   create_revision=prev.create_revision,
                                   mod_revision=new_rev, lease=lease)
@@ -253,7 +258,9 @@ class Store:
         new = Store.__new__(Store)
         new.revision = self.revision
         new.compact_revision = self.compact_revision
-        new.kvs = {k: KeyState(copy.deepcopy(v.value), v.version,
+        # stored values are never mutated in place (puts replace the
+        # KeyState wholesale), so clones can share them
+        new.kvs = {k: KeyState(v.value, v.version,
                                v.create_revision, v.mod_revision, v.lease)
                    for k, v in self.kvs.items()}
         new.events = [(r, list(evs)) for r, evs in self.events]

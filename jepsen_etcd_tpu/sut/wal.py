@@ -32,10 +32,15 @@ def encode_records(items: list[Any]) -> bytes:
     return bytes(out)
 
 
-def append_record(buf: bytes, item: Any) -> bytes:
+def record_bytes(item: Any) -> bytes:
+    """One framed record (MAGIC + length + crc + pickled payload)."""
     payload = pickle.dumps(item, protocol=4)
     crc = zlib.crc32(payload)
-    return buf + MAGIC + struct.pack("<II", len(payload), crc) + payload
+    return MAGIC + struct.pack("<II", len(payload), crc) + payload
+
+
+def append_record(buf: bytes, item: Any) -> bytes:
+    return buf + record_bytes(item)
 
 
 def decode_records(buf: bytes) -> tuple[list[Any], Optional[str]]:

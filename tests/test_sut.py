@@ -481,3 +481,34 @@ def test_split_vote_possible():
         set_current_loop(None)
     assert saw_competing_campaigns, \
         "no seed produced competing campaigns — elections look atomic"
+
+
+def test_fsync_mode_survives_lose_unfsynced():
+    """With unsafe_no_fsync=False every append is fsynced (durable WAL
+    mirrors the live one, incl. after truncation rewrites), so killing
+    all nodes losing unfsynced writes loses nothing."""
+    loop = SimLoop(seed=4)
+    set_current_loop(loop)
+    try:
+        cluster = Cluster(loop, ["n1", "n2", "n3"],
+                          ClusterConfig(unsafe_no_fsync=False))
+        cluster.launch()
+
+        async def main():
+            await await_leader(cluster)
+            for i in range(30):
+                await cluster.kv_txn("n1", put_txn(f"k{i}", i))
+            await sleep(500 * MS)
+            for n in list(cluster.nodes):
+                cluster.kill_node(n, lose_unfsynced=True)
+            for n in list(cluster.nodes):
+                cluster.start_node(n)
+            await await_leader(cluster, timeout_s=30)
+            for i in range(30):
+                out = await cluster.kv_read("n1", f"k{i}")
+                assert out["kv"] is not None and out["kv"]["value"] == i, i
+
+        loop.run_coro(main())
+        cluster.shutdown()
+    finally:
+        set_current_loop(None)
