@@ -31,7 +31,7 @@ from typing import Any, Optional
 import numpy as np
 
 from ...core.history import History
-from ...ops.closure import closure_batch
+from ...ops.closure import closure_batch_lazy
 
 WW, WR, RW, RT = "ww", "wr", "rw", "realtime"
 
@@ -190,7 +190,11 @@ class DepGraph:
         if realtime and self.rt is not None:
             levels += [(WW, RT), (WW, WR, RT), (WW, WR, RW, RT)]
         stack = np.stack([self._dense(*ets) for ets in levels])
-        reach, on_cycle = closure_batch(stack, force_device=force_device)
+        # reach is fetched lazily: only certificate recovery on invalid
+        # histories touches it, so valid checks skip the O(B*N^2)
+        # device->host transfer
+        reach_fn, on_cycle = closure_batch_lazy(stack,
+                                                force_device=force_device)
         adjs: dict[int, dict] = {}
 
         def adj(li: int) -> dict:
@@ -204,6 +208,7 @@ class DepGraph:
             `need`; `forbid` lists weaker levels the back-path must NOT
             exist at (so the cycle genuinely needs the edges `need`
             adds, and a weaker anomaly is never re-labeled here)."""
+            reach = reach_fn()
             for (a, b) in sorted(anchor_edges):
                 if not reach[need][b, a]:
                     continue

@@ -123,22 +123,28 @@ def _closure_numpy(a: np.ndarray) -> tuple:
     return r, on_cycle
 
 
-def closure_batch(adj: np.ndarray, force_device: bool | None = None):
-    """Close a [B, N, N] bool adjacency stack.
+def closure_batch_lazy(adj: np.ndarray, force_device: bool | None = None):
+    """Close a [B, N, N] bool adjacency stack, deferring the reach
+    transfer.
 
-    Returns (reach [B, N, N], on_cycle [B, N]) as numpy bool arrays,
-    trimmed back to the caller's N. Small problems run on host (device
-    dispatch would dominate); large ones pad to a bucketed size and run
-    the jitted squaring kernel.
+    Returns (reach_fn, on_cycle) where on_cycle is a numpy [B, N] bool
+    and reach_fn() materializes the [B, N, N] closure on first call
+    (cached). Cycle *detection* only needs on_cycle; the full reach
+    matrix is consulted only for certificate recovery on INVALID
+    histories — valid ones (the overwhelming case) skip the O(B*N^2)
+    device->host transfer entirely, which dominated Elle wall-clock at
+    device scale.
     """
     adj = np.asarray(adj, dtype=bool)
     if adj.ndim == 2:
         adj = adj[None]
     b, n, _ = adj.shape
     if n == 0:
-        return (np.zeros((b, 0, 0), bool), np.zeros((b, 0), bool))
+        empty = np.zeros((b, 0, 0), bool)
+        return (lambda: empty), np.zeros((b, 0), bool)
     if not use_device(force_device, n, CPU_CUTOFF, "closure_batch"):
-        return _closure_numpy(adj)
+        reach, on_cycle = _closure_numpy(adj)
+        return (lambda: reach), on_cycle
     m = _bucket(n)
     n_dev = len(jax.devices())
     if m % max(1, n_dev):  # row axis must split evenly over the mesh
@@ -147,9 +153,28 @@ def closure_batch(adj: np.ndarray, force_device: bool | None = None):
     pad[:, :n, :n] = adj
     iters = max(1, math.ceil(math.log2(m)))
     if n_dev > 1 and m >= SHARD_CUTOFF:
-        reach, on_cycle = _closure_device_sharded(pad, iters)
+        reach_dev, on_cycle = _closure_device_sharded(pad, iters)
     else:
-        reach, on_cycle = _closure_device(jnp.asarray(pad), iters)
-    reach = np.asarray(reach)[:, :n, :n]
+        reach_dev, on_cycle = _closure_device(jnp.asarray(pad), iters)
     on_cycle = np.asarray(on_cycle)[:, :n]
-    return reach, on_cycle
+    cache: list = []
+
+    def reach_fn():
+        if not cache:
+            cache.append(np.asarray(reach_dev)[:, :n, :n])
+        return cache[0]
+
+    return reach_fn, on_cycle
+
+
+def closure_batch(adj: np.ndarray, force_device: bool | None = None):
+    """Close a [B, N, N] bool adjacency stack.
+
+    Returns (reach [B, N, N], on_cycle [B, N]) as numpy bool arrays,
+    trimmed back to the caller's N. Small problems run on host (device
+    dispatch would dominate); large ones pad to a bucketed size and run
+    the jitted squaring kernel. Prefer ``closure_batch_lazy`` when the
+    reach matrix is only needed conditionally.
+    """
+    reach_fn, on_cycle = closure_batch_lazy(adj, force_device)
+    return reach_fn(), on_cycle

@@ -39,10 +39,6 @@ def record_bytes(item: Any) -> bytes:
     return MAGIC + struct.pack("<II", len(payload), crc) + payload
 
 
-def append_record(buf: bytes, item: Any) -> bytes:
-    return buf + record_bytes(item)
-
-
 def decode_records(buf: bytes) -> tuple[list[Any], Optional[str]]:
     """Decode records until the first damaged one.
 
