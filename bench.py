@@ -152,6 +152,7 @@ def bench_elle_append():
     committed = len([o for o in h if o.is_ok])
     checker = append_wl({"nodes": test["nodes"]})["checker"]
     checker.use_tpu = True  # force the device closure regardless of N
+    checker.check(test, h)  # warmup: closure compile
     t0 = time.time()
     res = checker.check(test, h)
     dt = time.time() - t0
@@ -170,6 +171,7 @@ def bench_watch():
     test, out = run_workload("watch", time_limit=60, rate=200)
     h = out["history"]
     checker = WatchChecker(use_tpu=True)
+    checker.check(test, h)  # warmup: wavefront-DP compile
     t0 = time.time()
     res = checker.check(test, h)
     dt = time.time() - t0

@@ -104,9 +104,15 @@ class LogFilePattern(Checker):
     (etcd.clj:134-140), with the reference's false-positive carve-out for
     membership-change restarts ("couldn't find local name")."""
 
-    def __init__(self, pattern: str = r"panic|fatal|SIG[A-Z]+",
+    def __init__(self,
+                 pattern: str = r'"level":"(fatal|panic)"|panic:'
+                                r'|^signal SIG',
                  exclude: str = r"couldn't find local name",
                  log_file: str = "etcd.log"):
+        # default matches the reference's regex (etcd.clj:139): JSON
+        # fatal/panic levels, literal "panic:", or a line-leading signal
+        # — NOT bare substrings like "fatal"/"SIG", which false-match
+        # fault-injection markers
         self.pattern = re.compile(pattern)
         self.exclude = re.compile(exclude)
         self.log_file = log_file

@@ -1092,12 +1092,15 @@ class Cluster:
         n = self.nodes[name]
         if n.alive:
             n.paused = True
-            n.log_line("paused (SIGSTOP)")
+            # NOTE: real etcd logs nothing while stopped (the process is
+            # frozen); keep the sim marker free of SIG[A-Z]+ so the
+            # crash-pattern checker (etcd.clj:134-140) can't false-match
+            n.log_line("paused (stop signal)")
 
     def resume_node(self, name: str) -> None:
         n = self.nodes[name]
         n.paused = False
-        n.log_line("resumed (SIGCONT)")
+        n.log_line("resumed (cont signal)")
         if n.resume_event is not None:
             n.resume_event.set()
             n.resume_event = None

@@ -187,6 +187,8 @@ class WatchClient(WorkloadClient):
                 return op.evolve(type="ok", value=res)
 
             if op.f == "final-watch":
+                violations: list = []
+
                 async def evolve(v):
                     try:
                         w = await self.watch_for(
@@ -195,19 +197,38 @@ class WatchClient(WorkloadClient):
                         return {"revision": w["revision"],
                                 "log": v["log"] + w["log"]}
                     except (SimError, TimeoutError) as e:
-                        if isinstance(e, SimError) and e.definite:
-                            raise  # nonmonotonic etc: surface it
+                        # the reference retries EVERY client error here
+                        # (watch.clj:258-261 catches client-error?, incl.
+                        # definite ones like compacted-under-admin) — a
+                        # raise would crash the whole converger; a stuck
+                        # watcher surfaces as converge-timeout instead.
+                        # A monotonicity violation is retried too, but
+                        # the evidence is preserved on the op (the
+                        # reference silently drops it here).
+                        if isinstance(e, SimError) and \
+                                e.type == "nonmonotonic-watch":
+                            violations.append(str(e))
                         await sleep(1 * SECOND)
                         return v
+
+                def done(type_, value, extra_error=None):
+                    err = None
+                    if violations:
+                        err = ["nonmonotonic-watch"] + violations[:4]
+                    elif extra_error:
+                        err = extra_error
+                    return op.evolve(type=type_, value=value,
+                                     **({"error": err} if err else {}))
+
                 try:
                     v = await self.converger.converge(
                         60 * SECOND,
                         {"revision": self.revision[0], "log": []}, evolve)
-                    return op.evolve(type="ok", value=v)
+                    return done("ok", v)
                 except ConvergeTimeout as e:
                     val = None if e.value in (_INIT, _EVOLVING) else e.value
-                    return op.evolve(type="ok", value=val,
-                                     error=["converge-timeout"])
+                    return done("ok", val,
+                                extra_error=["converge-timeout"])
             raise ValueError(f"unknown f {op.f}")
 
         # watch ops must fail definitely: an indefinite error would spin
