@@ -21,7 +21,7 @@ from collections import Counter, defaultdict
 from typing import Optional
 
 from ..core.history import History
-from ..ops.edit_distance import edit_distance, diff_report
+from ..ops.edit_distance import edit_distance_batch, diff_report
 from .core import Checker
 
 
@@ -68,12 +68,14 @@ class WatchChecker(Checker):
         revisions = per_thread_revisions(test, h)
         canonical = canonical_log(list(logs.values()))
         deltas = []
-        for thread, log in sorted(logs.items()):
-            ed = edit_distance(canonical, log,
-                               force_device=self.use_tpu)
+        threads = sorted(logs)
+        dists = edit_distance_batch(canonical, [logs[t] for t in threads],
+                                    force_device=self.use_tpu)
+        for thread, ed in zip(threads, dists):
             if ed:
                 deltas.append({"thread": thread, "edit-distance": ed,
-                               "diff": diff_report(canonical, log)})
+                               "diff": diff_report(canonical,
+                                                   logs[thread])})
         deltas.sort(key=lambda d: -d["edit-distance"])
         nm_errors = [op["error"] for op in h
                      if isinstance(op.get("error"), (list, tuple))

@@ -36,43 +36,79 @@ INF = np.int32(2 ** 30)
 if HAVE_JAX:
 
     @partial(jax.jit, static_argnames=("size",))
-    def _indel_device(a, b, n, m, size: int):
-        """a, b: int32[size] padded; n, m: actual lengths (traced).
-        Returns D[n, m] where D[i,j] = i + j - 2 * LCS(a[:i], b[:j])."""
-        l = size + 1  # diag vectors indexed by i in 0..size
-        i_idx = jnp.arange(l, dtype=jnp.int32)
+    def _indel_device_batch(a, b, n, m, size: int):
+        """Batched wavefront: a, b int32[K, size]; n, m int32[K].
+        Returns D[n_k, m_k] for every pair in ONE kernel — the diagonal
+        sweep is inherently sequential (2*size tiny steps), so its cost
+        is per-STEP latency; batching K pairs into the lanes makes the
+        whole watch check pay it once instead of K times. The loop stops
+        at max(n+m) rather than sweeping the padded tail."""
+        K = a.shape[0]
+        l = size + 1
+        i_idx = jnp.arange(l, dtype=jnp.int32)[None, :]          # [1, l]
+        gidx = jnp.clip(jnp.arange(l, dtype=jnp.int32) - 1, 0, size - 1)
+        d0 = jnp.broadcast_to(
+            jnp.where(i_idx == 0, 0, INF).astype(jnp.int32), (K, l))
+        d1 = jnp.broadcast_to(
+            jnp.where(i_idx <= 1, 1, INF).astype(jnp.int32), (K, l))
+        nm = n + m
+        res = jnp.where(nm == 0, 0,
+                        jnp.where(nm == 1, 1, INF)).astype(jnp.int32)
+        n_row = jnp.minimum(n, l - 1)[:, None]                   # [K, 1]
+        kmax = jnp.max(nm)
+        ai = jnp.take(a, gidx, axis=1)                           # [K, l]
 
-        # diag 0: D[0,0]=0 ; diag 1: D[0,1]=1, D[1,0]=1
-        d0 = jnp.where(i_idx == 0, 0, INF).astype(jnp.int32)
-        d1 = jnp.where(i_idx <= 1, 1, INF).astype(jnp.int32)
+        def cond(c):
+            k = c[0]
+            return k <= kmax
 
-        def step(carry, k):
-            dm2, dm1 = carry  # diags k-2 and k-1
-            j_idx = k - i_idx  # j for each cell on diag k
-            # gather compared elements (clip keeps gathers in-bounds;
-            # out-of-range cells are masked below)
-            ai = a[jnp.clip(i_idx - 1, 0, size - 1)]
-            bj = b[jnp.clip(j_idx - 1, 0, size - 1)]
+        def body(c):
+            k, dm2, dm1, res = c
+            j_idx = k - i_idx                                    # [1, l]
+            bj = jnp.take(b, jnp.clip(k - 1 - jnp.arange(
+                l, dtype=jnp.int32), 0, size - 1), axis=1)       # [K, l]
             match = ai == bj
-            up = jnp.roll(dm1, 1).at[0].set(INF)      # D[i-1, j]
-            left = dm1                                 # D[i, j-1]
-            diag = jnp.roll(dm2, 1).at[0].set(INF)     # D[i-1, j-1]
-            dk = jnp.where(match, diag,
-                           jnp.minimum(up, left) + 1)
-            # boundaries: i == 0 -> j ; j == 0 -> i
+            up = jnp.roll(dm1, 1, axis=1).at[:, 0].set(INF)
+            diag = jnp.roll(dm2, 1, axis=1).at[:, 0].set(INF)
+            dk = jnp.where(match, diag, jnp.minimum(up, dm1) + 1)
             dk = jnp.where(i_idx == 0, k, dk)
             dk = jnp.where(j_idx == 0, i_idx, dk)
-            dk = jnp.where((j_idx < 0) | (i_idx > k), INF, dk).astype(
-                jnp.int32)
-            return (dm1, dk), dk[jnp.minimum(n, l - 1)]
+            dk = jnp.where((j_idx < 0) | (i_idx > k), INF,
+                           dk).astype(jnp.int32)
+            at_n = jnp.take_along_axis(dk, n_row, axis=1)[:, 0]
+            res = jnp.where(k == nm, at_n, res)
+            return k + 1, dm1, dk, res
 
-        ks = jnp.arange(2, 2 * size + 1, dtype=jnp.int32)
-        (_, _), at_n = jax.lax.scan(step, (d0, d1), ks)
-        # at_n[t] = D[n, (t+2) - n]; we want D[n, m] -> t = n + m - 2
-        full = jnp.concatenate([
-            jnp.array([d0[jnp.minimum(n, l - 1)],
-                       d1[jnp.minimum(n, l - 1)]], jnp.int32), at_n])
-        return full[n + m]
+        _, _, _, res = jax.lax.while_loop(
+            cond, body, (jnp.int32(2), d0, d1, res))
+        return res
+
+
+def edit_distance_batch(canonical, logs: list,
+                        force_device: bool | None = None) -> list[int]:
+    """Indel edit distance of each log vs the canonical, in one device
+    launch (the watch checker's per-thread divergence measure)."""
+    lens = [len(l) for l in logs] + [len(canonical)]
+    if not logs:
+        return []
+    if not use_device(force_device, max(lens), CPU_CUTOFF,
+                      "edit_distance"):
+        return [_indel_python(list(canonical), list(l)) for l in logs]
+    enc = _encode([list(canonical)] + [list(l) for l in logs])
+    ec, elogs = enc[0], enc[1:]
+    size = _bucket(max(lens))
+    K = len(logs)
+    pa = np.full((K, size), -1, np.int32)
+    pb = np.full((K, size), -2, np.int32)  # distinct pads never match
+    n = np.full(K, len(ec), np.int32)
+    m = np.zeros(K, np.int32)
+    for k, el in enumerate(elogs):
+        pa[k, :len(ec)] = ec
+        pb[k, :len(el)] = el
+        m[k] = len(el)
+    out = _indel_device_batch(jnp.asarray(pa), jnp.asarray(pb),
+                              jnp.asarray(n), jnp.asarray(m), size)
+    return [int(v) for v in np.asarray(out)]
 
 
 def _indel_python(a, b) -> int:
@@ -103,19 +139,9 @@ def _encode(seqs: list) -> list[np.ndarray]:
 
 
 def edit_distance(a, b, force_device: bool | None = None) -> int:
-    """Indel edit distance between two sequences of hashable elements."""
-    n, m = len(a), len(b)
-    if not use_device(force_device, max(n, m), CPU_CUTOFF,
-                      "edit_distance"):
-        return _indel_python(list(a), list(b))
-    ea, eb = _encode([list(a), list(b)])
-    size = _bucket(max(n, m))
-    pa = np.full(size, -1, np.int32)
-    pb = np.full(size, -2, np.int32)  # distinct pads can never match
-    pa[:n] = ea
-    pb[:m] = eb
-    return int(_indel_device(jnp.asarray(pa), jnp.asarray(pb),
-                             jnp.int32(n), jnp.int32(m), size))
+    """Indel edit distance between two sequences of hashable elements
+    (the K=1 case of the batched kernel)."""
+    return edit_distance_batch(a, [b], force_device=force_device)[0]
 
 
 def diff_report(canonical, log) -> dict:

@@ -221,3 +221,25 @@ def test_watch_workload_e2e(tmp_path):
     assert wl["valid?"] is True, wl
     # watchers actually observed writes
     assert sum(wl["revisions"].values()) > 0
+
+
+def test_edit_distance_batch_matches_single():
+    import random
+    from jepsen_etcd_tpu.ops.edit_distance import (
+        edit_distance, edit_distance_batch, _indel_python)
+    rng = random.Random(8)
+    canonical = [rng.randrange(6) for _ in range(200)]
+    logs = []
+    for _ in range(5):
+        log = list(canonical)
+        for _ in range(rng.randrange(0, 12)):   # random indels
+            if log and rng.random() < 0.5:
+                log.pop(rng.randrange(len(log)))
+            else:
+                log.insert(rng.randrange(len(log) + 1), rng.randrange(6))
+        logs.append(log)
+    logs.append([])                              # empty log edge case
+    batch = edit_distance_batch(canonical, logs, force_device=True)
+    for log, got in zip(logs, batch):
+        assert got == _indel_python(canonical, log)
+        assert got == edit_distance(canonical, log, force_device=True)
