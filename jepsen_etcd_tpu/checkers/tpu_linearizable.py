@@ -57,14 +57,22 @@ class TPULinearizableChecker(Checker):
                 if k in cpu:
                     out[k] = cpu[k]
             return out
-        return self._fallback(history, out.get("reason", "unknown"))
+        return self._fallback(history, out.get("reason", "unknown"),
+                              blowup=bool(out.get("blowup")))
 
-    def _fallback(self, history, reason: str) -> dict:
+    def _fallback(self, history, reason: str,
+                  blowup: bool = False) -> dict:
         if not self.fallback:
             return {"valid?": "unknown", "reason": reason,
                     "checker": "tpu-wgl"}
         logger.debug("TPU path unavailable (%s); CPU oracle", reason)
-        out = check_history(self.model_fn(), history)
+        # blowup (a structured flag set wherever the kernel/packer
+        # proves the space astronomical): the DFS oracle almost
+        # certainly can't finish either — give it a cheap shot (it can
+        # still find a witness for valid histories fast) instead of
+        # burning the full budget for minutes per key
+        kwargs = {"max_configs": 1_000_000} if blowup else {}
+        out = check_history(self.model_fn(), history, **kwargs)
         out["checker"] = "cpu-oracle"
         out["tpu-fallback-reason"] = reason
         return out
@@ -76,7 +84,7 @@ class TPULinearizableChecker(Checker):
             return self._fallback(history, "model has no kernel packing")
         p = pack(history)
         if not p.ok:
-            return self._fallback(history, p.reason)
+            return self._fallback(history, p.reason, blowup=p.blowup)
         return self._finalize(history, wgl.check_packed(p, f_max=self.f_max))
 
     def check_batch(self, test, subhistories: dict, opts=None) -> dict:

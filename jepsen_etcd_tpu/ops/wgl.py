@@ -72,6 +72,13 @@ WILDCARD = -1    # read asserted nothing
 SPILL_CHUNK = 4096
 SPILL_FRONTIER_LIMIT = 400_000
 SPILL_STATE_BUDGET = 3_000_000
+# at/above this many kept info ops the POTENTIAL space is >= 2^24 info
+# subsets (symmetry + infeasibility may prune it far smaller, so the
+# spill still runs — it can deliver definitive verdicts when the
+# reachable space is modest), but its state budget shrinks so hopeless
+# cases exit in seconds rather than minutes
+SPILL_I_LIMIT = 24
+SPILL_STATE_BUDGET_HIGH_I = 1_000_000
 
 
 def split_words(m64: np.ndarray, nw: int) -> np.ndarray:
@@ -88,6 +95,7 @@ class Packed:
 
     ok: bool
     reason: str = ""
+    blowup: bool = False  # state space provably astronomical (>=2^32)
     R: int = 0
     I: int = 0
     n_values: int = 0
@@ -219,7 +227,7 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
         infos.append((e, npred))
     I = len(infos)
     if I > min(i_max, I_MAX):
-        return Packed(ok=False,
+        return Packed(ok=False, blowup=True,
                       reason=f"{I} info updates > imask capacity {I_MAX}")
     i_f = np.zeros(I, dtype=np.int8)
     i_a1 = np.zeros(I, dtype=np.int32)
@@ -726,14 +734,14 @@ def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
         states_total += fr.shape[0]
         peak = max(peak, fr.shape[0])
         if fr.shape[0] > SPILL_FRONTIER_LIMIT:
-            return {"valid?": "unknown",
+            return {"valid?": "unknown", "blowup": True,
                     "reason": f"spill frontier {fr.shape[0]} > "
-                              f"{SPILL_FRONTIER_LIMIT} (blowup; CPU DFS "
-                              f"is the right tool)",
+                              f"{SPILL_FRONTIER_LIMIT}",
                     "peak-frontier": peak, "spilled": True}
         if states_total > state_budget:
-            return {"valid?": "unknown",
-                    "reason": f"spill budget exceeded ({states_total} states)",
+            return {"valid?": "unknown", "blowup": True,
+                    "reason": f"spill budget exceeded "
+                              f"({states_total} states)",
                     "peak-frontier": peak, "spilled": True}
     if fr.shape[0]:
         # wave-budget backstop tripped with work remaining: cannot happen
@@ -774,7 +782,8 @@ def check_packed_batch(packs: list, f_max: Optional[int] = None) -> list:
     groups: dict = {}
     for i, p in enumerate(packs):
         if not p.ok:
-            results[i] = {"valid?": "unknown", "reason": p.reason}
+            results[i] = {"valid?": "unknown", "reason": p.reason,
+                          "blowup": p.blowup}
         elif p.R == 0:
             results[i] = {"valid?": True, "waves": 0}
         else:
@@ -859,7 +868,8 @@ def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
     import jax.numpy as jnp
 
     if not p.ok:
-        return {"valid?": "unknown", "reason": p.reason}
+        return {"valid?": "unknown", "reason": p.reason,
+                "blowup": p.blowup}
     if p.R == 0:
         return {"valid?": True, "waves": 0}
     # f_max (when given) is the STARTING rung; the ladder still
@@ -896,7 +906,10 @@ def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
         peak_all = max(peak_all, int(peak))
     valid = bool(valid)
     if bool(overflow):
-        out = _spill_bfs(p, tables, frontier, int(k))
+        out = _spill_bfs(p, tables, frontier, int(k),
+                         state_budget=SPILL_STATE_BUDGET
+                         if p.I < SPILL_I_LIMIT
+                         else SPILL_STATE_BUDGET_HIGH_I)
         out["peak-frontier"] = max(peak_all, out.get("peak-frontier", 0))
         return out
     return {"valid?": valid, "waves": int(k), "peak-frontier": peak_all,
