@@ -108,13 +108,27 @@ def gen_history(rng: random.Random, n_procs=4, n_ops=20, values=3,
     return History(ops)
 
 
+#: scale the differential fuzz via env (2500 trials ran clean in ~70 s
+#: on the CPU mesh; default stays CI-sized); floor of 15 keeps the
+#: definitive-coverage assertion meaningful
+def _fuzz_trials() -> int:
+    import os
+    try:
+        return max(15, int(os.environ.get("WGL_FUZZ_TRIALS", "150")))
+    except ValueError:
+        return 150
+
+
+FUZZ_TRIALS = _fuzz_trials()
+
+
 @pytest.mark.parametrize("corrupt", [False, True])
 def test_differential_random_histories(corrupt):
     rng = random.Random(1234 if corrupt else 99)
     checker = TPULinearizableChecker(fallback=False)
     agree = 0
     definitive = 0
-    for trial in range(150):
+    for trial in range(FUZZ_TRIALS):
         h = gen_history(rng, n_procs=rng.randint(2, 5),
                         n_ops=rng.randint(8, 32), corrupt=corrupt)
         cpu = check_history(VersionedRegister(), h)
@@ -127,7 +141,8 @@ def test_differential_random_histories(corrupt):
             + h.to_jsonl())
         agree += 1
     # the kernel must actually cover the vast majority of histories
-    assert definitive >= 130, f"only {definitive}/150 definitive"
+    assert definitive >= FUZZ_TRIALS * 13 // 15, \
+        f"only {definitive}/{FUZZ_TRIALS} definitive"
 
 
 def test_clean_histories_all_valid():
