@@ -92,16 +92,24 @@ def bench_register_100():
     p = wgl.pack_register_history(h)
     assert p.ok, p.reason
     t0 = time.time()
-    cpu = check_history(VersionedRegister(), h)
+    cpu = check_history(VersionedRegister(), h, use_native=False)
     cpu_s = time.time() - t0
+    from jepsen_etcd_tpu.native import get_lib
+    get_lib()  # warmup: one-time g++ build must not land in the timer
+    t0 = time.time()
+    nat = check_history(VersionedRegister(), h)
+    native_s = time.time() - t0
     wgl.check_packed(p)
     t1 = time.time()
     tpu = wgl.check_packed(p)
     tpu_s = time.time() - t1
     assert tpu["valid?"] is True and cpu["valid?"] is True
-    note(f"100-op: cpu={cpu_s:.4f}s tpu={tpu_s:.4f}s")
+    assert nat["valid?"] is True
+    note(f"100-op: cpu={cpu_s:.4f}s native={native_s:.4f}s "
+         f"tpu={tpu_s:.4f}s")
     return {"value": round(tpu_s, 4), "unit": "s",
             "cpu_oracle_s": round(cpu_s, 4),
+            "native_oracle_s": round(native_s, 4),
             "ops": p.R, "vs_baseline": round(BASELINE_SECONDS / max(
                 tpu_s, 1e-9), 1)}
 

@@ -79,15 +79,24 @@ def history_entries(history) -> Optional[list[Entry]]:
     return entries
 
 
-def check_history(model: Model, history, max_configs: int = 5_000_000) -> dict:
-    """WGL search. Returns {'valid?': bool|'unknown', ...}."""
+def check_history(model: Model, history, max_configs: int = 5_000_000,
+                  use_native: bool = True) -> dict:
+    """WGL search. Returns {'valid?': bool|'unknown', ...}.
+
+    Models expressible as (versioned) CAS registers run on the native
+    C++ engine (native/wgl_oracle.cpp, ~100x this DFS); this Python
+    search is the semantic reference the native engine is
+    differentially tested against, and the path for other models."""
     entries = history_entries(history)
     n = len(entries)
     if n == 0:
         return {"valid?": True, "configs": 0, "ops": 0}
-    if n > 1000:
-        # mask ints get slow; callers should use the TPU kernel for this
-        pass
+    if use_native:
+        from ..native import oracle as native_oracle
+        out = native_oracle.check_entries(model, entries,
+                                          max_configs=max_configs)
+        if out is not None:
+            return out
     full_required = 0
     for e in entries:
         if e.required:
@@ -100,9 +109,14 @@ def check_history(model: Model, history, max_configs: int = 5_000_000) -> dict:
     best_blocked: Optional[list] = None
     while stack:
         mask, state = stack.pop()
-        if (mask, state) in visited:
-            continue
-        visited.add((mask, state))
+        try:
+            if (mask, state) in visited:
+                continue
+            visited.add((mask, state))
+        except TypeError:
+            # unhashable model state (e.g. a set-valued register):
+            # proceed without memoizing — correct, just slower
+            pass
         configs += 1
         if configs > max_configs:
             return {"valid?": "unknown", "error": "search budget exceeded",

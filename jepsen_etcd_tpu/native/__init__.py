@@ -1,0 +1,11 @@
+"""Native (C++) components of the framework runtime.
+
+The reference's performance-critical non-JVM surface lives in native
+dependencies (etcd/Go, lazyfs/C++, netty epoll — SURVEY §2.2); here the
+native citizen is the checker fallback engine: a C++ WGL search
+(wgl_oracle.cpp) driven through ctypes (oracle.py).
+"""
+
+from .oracle import check_entries, get_lib
+
+__all__ = ["check_entries", "get_lib"]

@@ -1,0 +1,217 @@
+// Native WGL linearizability oracle (C++ core of checkers/linearizable).
+//
+// The reference's Knossos search runs on the JVM with a 24 GB heap
+// (project.clj:21-23); our CPU fallback path is this C++ depth-first
+// search over (linearized-mask, register-value) configurations with a
+// memoizing visited set — the same semantics as the Python oracle
+// (checkers/linearizable.py, differential-tested against it), roughly
+// two orders of magnitude faster. It handles histories the TPU kernel
+// cannot pack (window > 64, info ops > 32) before any "unknown" verdict
+// is accepted.
+//
+// Register language (matches ops/wgl.py packing):
+//   f: 0 read / 1 write / 2 cas
+//   a1: read expected value (or WILDCARD) / write value / cas old
+//   a2: cas new
+//   ver: version assertion (NO_ASSERT when absent). Version semantics are
+//        VersionedRegister's (models/versioned_register.py): updates
+//        assert version+1, reads assert version; version is DERIVED —
+//        the count of linearized updates, a function of the mask — it
+//        rides in the frame word beside the value for cheap access and
+//        adds no distinct states to the visited set.
+//   inv/ret: total-order positions; ret = INT64_MAX for :info ops.
+//   req: 1 for :ok ops (must linearize), 0 for :info (may, or never).
+//   sym_pred: canonical-order predecessor for interchangeable info ops
+//        (identical f/a1/a2); -1 when none. Restricting the search to
+//        fire each class in order collapses 2^I symmetric subsets.
+//
+// Returns 1 valid, 0 invalid, 2 search budget exceeded.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int32_t NO_ASSERT = -(1 << 30);
+constexpr int32_t WILDCARD = -1;
+constexpr int8_t F_READ = 0, F_WRITE = 1, F_CAS = 2;
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+// Open-addressing hash set over fixed-width uint64 keys.
+struct KeySet {
+  size_t kw = 0, cap = 0, cnt = 0, mask = 0;
+  std::vector<uint64_t> slots;
+  std::vector<uint8_t> used;
+
+  void init(size_t key_words, size_t cap0) {
+    kw = key_words;
+    cap = 64;
+    while (cap < cap0) cap <<= 1;
+    mask = cap - 1;
+    slots.assign(cap * kw, 0);
+    used.assign(cap, 0);
+    cnt = 0;
+  }
+
+  uint64_t hash(const uint64_t* key) const {
+    uint64_t h = 0x2545f4914f6cdd1dULL;
+    for (size_t i = 0; i < kw; i++) h = splitmix64(h ^ key[i]);
+    return h;
+  }
+
+  void grow() {
+    std::vector<uint64_t> old_slots;
+    std::vector<uint8_t> old_used;
+    old_slots.swap(slots);
+    old_used.swap(used);
+    size_t old_cap = cap;
+    cap <<= 1;
+    mask = cap - 1;
+    slots.assign(cap * kw, 0);
+    used.assign(cap, 0);
+    for (size_t i = 0; i < old_cap; i++) {
+      if (!old_used[i]) continue;
+      const uint64_t* key = &old_slots[i * kw];
+      size_t j = hash(key) & mask;
+      while (used[j]) j = (j + 1) & mask;
+      std::memcpy(&slots[j * kw], key, kw * 8);
+      used[j] = 1;
+    }
+  }
+
+  // true iff the key was newly inserted.
+  bool insert(const uint64_t* key) {
+    size_t i = hash(key) & mask;
+    while (used[i]) {
+      if (!std::memcmp(&slots[i * kw], key, kw * 8)) return false;
+      i = (i + 1) & mask;
+    }
+    std::memcpy(&slots[i * kw], key, kw * 8);
+    used[i] = 1;
+    cnt++;
+    if (cnt * 10 > cap * 7) grow();
+    return true;
+  }
+};
+
+inline bool get_bit(const uint64_t* m, int32_t i) {
+  return (m[i >> 6] >> (i & 63)) & 1ULL;
+}
+
+}  // namespace
+
+extern "C" int32_t wgl_oracle_check(
+    int32_t n, const int8_t* f, const int32_t* a1, const int32_t* a2,
+    const int32_t* ver, const int64_t* inv, const int64_t* ret,
+    const uint8_t* req, const int32_t* sym_pred, int64_t max_configs,
+    int64_t* configs_out, int32_t* blocked_op_out, int32_t* best_depth_out,
+    int32_t* blocked_version_out, int32_t* blocked_value_out) {
+  const size_t nw = (static_cast<size_t>(n) + 63) / 64;
+  const size_t fw = nw + 1;  // frame: mask words + (value<<32 | version)
+
+  // required ops ordered by return position (for the min-ret scan)
+  std::vector<int32_t> req_order;
+  req_order.reserve(n);
+  for (int32_t i = 0; i < n; i++)
+    if (req[i]) req_order.push_back(i);
+  for (size_t i = 1; i < req_order.size(); i++) {  // insertion sort by ret
+    int32_t v = req_order[i];
+    size_t j = i;
+    while (j > 0 && ret[req_order[j - 1]] > ret[v]) {
+      req_order[j] = req_order[j - 1];
+      j--;
+    }
+    req_order[j] = v;
+  }
+
+  KeySet visited;
+  visited.init(fw, 1 << 16);
+  std::vector<uint64_t> stack;  // frames, popped from the back
+  stack.assign(fw, 0);          // initial: empty mask, value 0, version 0
+
+  int64_t configs = 0;
+  int32_t best_depth = -1, blocked_op = -1;
+  int32_t blocked_version = 0, blocked_value = 0;
+  std::vector<uint64_t> frame(fw), child(fw);
+
+  while (!stack.empty()) {
+    std::memcpy(frame.data(), stack.data() + stack.size() - fw, fw * 8);
+    stack.resize(stack.size() - fw);
+    if (!visited.insert(frame.data())) continue;
+    if (++configs > max_configs) {
+      *configs_out = configs;
+      return 2;
+    }
+    const uint64_t* m = frame.data();
+    const int32_t value = static_cast<int32_t>(frame[nw] >> 32);
+    const int32_t version =
+        static_cast<int32_t>(frame[nw] & 0xffffffffULL);
+
+    int64_t min_ret = INT64_MAX;
+    for (int32_t idx : req_order) {
+      if (!get_bit(m, idx)) {
+        min_ret = ret[idx];
+        break;
+      }
+    }
+    if (min_ret == INT64_MAX) {  // every required op linearized
+      *configs_out = configs;
+      *blocked_version_out = version;
+      *blocked_value_out = value;
+      return 1;
+    }
+
+    for (int32_t e = 0; e < n; e++) {
+      if (get_bit(m, e)) continue;
+      if (inv[e] >= min_ret) continue;
+      if (sym_pred[e] >= 0 && !get_bit(m, sym_pred[e])) continue;
+      bool ok;
+      int32_t nval;
+      if (f[e] == F_READ) {
+        ok = (ver[e] == NO_ASSERT || ver[e] == version) &&
+             (a1[e] == WILDCARD || a1[e] == value);
+        nval = value;
+      } else if (f[e] == F_WRITE) {
+        ok = (ver[e] == NO_ASSERT || ver[e] == version + 1);
+        nval = a1[e];
+      } else {
+        ok = (ver[e] == NO_ASSERT || ver[e] == version + 1) &&
+             a1[e] == value;
+        nval = a2[e];
+      }
+      if (!ok) {
+        if (req[e]) {
+          int32_t d = 0;
+          for (size_t w = 0; w < nw; w++) d += __builtin_popcountll(m[w]);
+          if (d >= best_depth) {
+            best_depth = d;
+            blocked_op = e;
+            blocked_version = version;
+            blocked_value = value;
+          }
+        }
+        continue;
+      }
+      const int32_t nver = (f[e] == F_READ) ? version : version + 1;
+      std::memcpy(child.data(), m, nw * 8);
+      child[e >> 6] |= 1ULL << (e & 63);
+      child[nw] = (static_cast<uint64_t>(static_cast<uint32_t>(nval)) << 32) |
+                  static_cast<uint32_t>(nver);
+      stack.insert(stack.end(), child.begin(), child.end());
+    }
+  }
+
+  *configs_out = configs;
+  *blocked_op_out = blocked_op;
+  *best_depth_out = best_depth;
+  *blocked_version_out = blocked_version;
+  *blocked_value_out = blocked_value;
+  return 0;
+}

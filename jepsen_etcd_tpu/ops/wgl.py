@@ -47,6 +47,7 @@ from typing import Any, Optional
 import numpy as np
 
 from ..checkers.linearizable import Entry, history_entries
+from .common import UnsupportedValue, ValueIds, as_version
 
 W = 32          # single-word window width (fast path)
 W_MAX = 64      # two-word window width (high-overlap histories: long
@@ -141,14 +142,23 @@ def pack_mutex_history(history, i_max: int = I_MAX) -> Packed:
                                  adapter=mutex_adapter)
 
 
-def pack_register_history(history, value_ids: Optional[dict] = None,
-                          i_max: int = I_MAX,
+def pack_register_history(history, i_max: int = I_MAX,
                           adapter=None) -> Packed:
     """Build the per-depth tables for the kernel. Returns ok=False with a
     reason when the history needs the CPU path. ``adapter`` (optional)
     maps each entry's (f, value) into register-language (f, value) —
     models expressible as CAS registers (e.g. Mutex) reuse the whole
     kernel this way."""
+    try:
+        return _pack_register_history(history, i_max=i_max,
+                                      adapter=adapter)
+    except UnsupportedValue as e:
+        # a value/version whose == semantics the dense id encoding can't
+        # carry: sound fallback to the Python oracle
+        return Packed(ok=False, reason=f"unsupported value: {e}")
+
+
+def _pack_register_history(history, i_max: int, adapter) -> Packed:
     entries = history_entries(history)
     if adapter is not None:
         adapted = {}
@@ -171,16 +181,10 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
         # ops may simply never have happened)
         return Packed(ok=True, R=0)
 
-    # value id mapping: 0 = None (unset); concrete values from 1
-    vid = dict(value_ids or {})
-
-    def val_id(v):
-        if v is None:
-            return NONE_VAL
-        key = repr(v)
-        if key not in vid:
-            vid[key] = max(vid.values(), default=NONE_VAL) + 1
-        return vid[key]
+    # value id mapping: 0 = None (unset); concrete values from 1, with
+    # id-equality iff Python == (ops/common.ValueIds)
+    vids = ValueIds()
+    val_id = vids.id
 
     inv = np.array([e.invoke for e in req], dtype=np.int64)
     ret = np.array([e.ret for e in req], dtype=np.int64)
@@ -193,7 +197,7 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
         if ef == "read":
             f[i] = READ
             rv, rval = ev if ev is not None else (None, None)
-            ver[i] = NO_ASSERT if rv is None else int(rv)
+            ver[i] = NO_ASSERT if rv is None else as_version(rv)
             # A None read value asserts nothing (VersionedRegister.step
             # treats nil op-value as unchecked REGARDLESS of version —
             # an unset-key read [0, None] is constrained via version 0).
@@ -201,12 +205,12 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
         elif ef == "write":
             f[i] = WRITE
             wv, wval = ev
-            ver[i] = NO_ASSERT if wv is None else int(wv)
+            ver[i] = NO_ASSERT if wv is None else as_version(wv)
             a1[i] = val_id(wval)
         elif ef == "cas":
             f[i] = CAS
             cv, (old, new) = ev
-            ver[i] = NO_ASSERT if cv is None else int(cv)
+            ver[i] = NO_ASSERT if cv is None else as_version(cv)
             a1[i] = val_id(old)
             a2[i] = val_id(new)
         else:
@@ -335,7 +339,7 @@ def pack_register_history(history, value_ids: Optional[dict] = None,
         i_static_ok = np.zeros((R, 0), dtype=bool)
 
     return Packed(
-        ok=True, R=R, I=I, n_values=len(vid) + 1, w=w,
+        ok=True, R=R, I=I, n_values=len(vids.rev), w=w,
         shift=(lo[1:] - lo[:-1]).astype(np.int32),
         static_ok=static_ok,
         f_code=f[idx].astype(np.int8),

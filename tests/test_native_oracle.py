@@ -1,0 +1,186 @@
+"""Differential tests: native C++ WGL oracle vs the Python DFS.
+
+The native engine (jepsen_etcd_tpu/native) must agree with the Python
+oracle — the semantic reference — on every verdict, for every model it
+claims to support (VersionedRegister, Mutex, CASRegister).
+"""
+
+import random
+
+import pytest
+
+from jepsen_etcd_tpu.core.op import Op
+from jepsen_etcd_tpu.core.history import History
+from jepsen_etcd_tpu.checkers.linearizable import (check_history,
+                                                   history_entries)
+from jepsen_etcd_tpu.models import VersionedRegister, Mutex, CASRegister
+from jepsen_etcd_tpu.native import oracle as native
+
+from test_wgl import gen_history, gen_mutex_history
+
+
+def test_native_lib_builds():
+    assert native.get_lib() is not None, \
+        "g++ is baked into the image; the native oracle must build"
+
+
+@pytest.mark.parametrize("corrupt,info_rate",
+                         [(False, 0.0), (True, 0.0),
+                          (False, 0.25), (True, 0.25)])
+def test_differential_register(corrupt, info_rate):
+    rng = random.Random(hash(("native", corrupt, info_rate)) & 0xFFFF)
+    for trial in range(120):
+        h = gen_history(rng, n_procs=rng.randint(2, 5),
+                        n_ops=rng.randint(8, 32), corrupt=corrupt,
+                        info_rate=info_rate)
+        nat = check_history(VersionedRegister(), h)
+        py = check_history(VersionedRegister(), h, use_native=False)
+        assert nat.get("checker-impl") == "native"
+        assert nat["valid?"] == py["valid?"], (
+            f"trial {trial}: native={nat} python={py['valid?']}\n"
+            + h.to_jsonl())
+
+
+@pytest.mark.parametrize("corrupt,info_rate",
+                         [(False, 0.0), (True, 0.0), (False, 0.25)])
+def test_differential_mutex(corrupt, info_rate):
+    rng = random.Random(hash(("native-mutex", corrupt, info_rate)) & 0xFFFF)
+    for trial in range(100):
+        h = gen_mutex_history(rng, n_procs=rng.randint(2, 4),
+                              n_ops=rng.randint(6, 24),
+                              corrupt=corrupt, info_rate=info_rate)
+        nat = check_history(Mutex(), h)
+        py = check_history(Mutex(), h, use_native=False)
+        assert nat.get("checker-impl") == "native"
+        assert nat["valid?"] == py["valid?"], (
+            f"trial {trial}: native={nat} python={py['valid?']}\n"
+            + h.to_jsonl())
+
+
+def test_invalid_history_diagnostics():
+    # read of a value never written: invalid, with op + model error
+    ops = [
+        Op(type="invoke", process=0, f="write", value=[None, 1]),
+        Op(type="ok", process=0, f="write", value=[1, 1]),
+        Op(type="invoke", process=1, f="read", value=[None, None]),
+        Op(type="ok", process=1, f="read", value=[1, 2]),
+    ]
+    out = check_history(VersionedRegister(), History(ops))
+    assert out.get("checker-impl") == "native"
+    assert out["valid?"] is False
+    assert "op" in out and "error" in out
+    assert "read" in out["error"] or "can't" in out["error"]
+
+
+def test_cas_register_adapter():
+    ops = [
+        Op(type="invoke", process=0, f="write", value="a"),
+        Op(type="ok", process=0, f="write", value="a"),
+        Op(type="invoke", process=1, f="cas", value=["a", "b"]),
+        Op(type="ok", process=1, f="cas", value=["a", "b"]),
+        Op(type="invoke", process=0, f="read", value=None),
+        Op(type="ok", process=0, f="read", value="b"),
+    ]
+    out = check_history(CASRegister(), History(ops))
+    assert out.get("checker-impl") == "native"
+    assert out["valid?"] is True
+    # and an impossible read is invalid
+    bad = ops + [
+        Op(type="invoke", process=0, f="read", value=None),
+        Op(type="ok", process=0, f="read", value="z"),
+    ]
+    out = check_history(CASRegister(), History(bad))
+    assert out["valid?"] is False
+
+
+def test_unsupported_model_returns_none():
+    # non-initial model states have no register-language packing
+    ents = history_entries(History([
+        Op(type="invoke", process=0, f="read", value=[3, "x"]),
+        Op(type="ok", process=0, f="read", value=[3, "x"]),
+    ]))
+    assert native.check_entries(VersionedRegister(3, "x"), ents) is None
+    # and check_history still answers through the Python DFS
+    out = check_history(VersionedRegister(3, "x"), History([
+        Op(type="invoke", process=0, f="read", value=[3, "x"]),
+        Op(type="ok", process=0, f="read", value=[3, "x"]),
+    ]))
+    assert out["valid?"] is True
+    assert "checker-impl" not in out
+
+
+def test_kill_switch(monkeypatch):
+    monkeypatch.setenv("JEPSEN_ETCD_TPU_NO_NATIVE", "1")
+    assert native.get_lib() is None
+    out = check_history(VersionedRegister(), History([
+        Op(type="invoke", process=0, f="write", value=[None, 1]),
+        Op(type="ok", process=0, f="write", value=[1, 1]),
+    ]))
+    assert out["valid?"] is True
+    assert "checker-impl" not in out
+
+
+def test_budget_exceeded_is_unknown():
+    rng = random.Random(31)
+    h = gen_history(rng, n_procs=6, n_ops=60, info_rate=0.4)
+    out = check_history(VersionedRegister(), h, max_configs=3)
+    assert out.get("checker-impl") == "native"
+    assert out["valid?"] in ("unknown", True)  # tiny budget: likely unknown
+
+
+@pytest.mark.parametrize("read_val,expect", [(1.0, True), ("1", False),
+                                             (True, True)])
+def test_value_equality_semantics(read_val, expect):
+    """Value-id equality must be Python == (1 == 1.0 == True; '1' is
+    not) so packed encodings agree with VersionedRegister.step — on the
+    native engine, the Python DFS, AND the TPU kernel."""
+    from jepsen_etcd_tpu.checkers.tpu_linearizable import (
+        TPULinearizableChecker)
+    ops = [
+        Op(type="invoke", process=0, f="write", value=[None, 1]),
+        Op(type="ok", process=0, f="write", value=[1, 1]),
+        Op(type="invoke", process=1, f="read", value=[None, None]),
+        Op(type="ok", process=1, f="read", value=[1, read_val]),
+    ]
+    h = History(ops)
+    nat = check_history(VersionedRegister(), h)
+    py = check_history(VersionedRegister(), h, use_native=False)
+    tpu = TPULinearizableChecker(fallback=True).check({}, h)
+    assert py["valid?"] is expect
+    assert nat["valid?"] is expect
+    assert tpu["valid?"] is expect
+
+
+def test_nonint_version_assertion_falls_back_soundly():
+    """A malformed (string) version assertion must not crash and must
+    match the Python DFS verdict (invalid: 'x' != any int version)."""
+    ops = [
+        Op(type="invoke", process=0, f="write", value=[None, 1]),
+        Op(type="ok", process=0, f="write", value=["x", 1]),
+    ]
+    h = History(ops)
+    out = check_history(VersionedRegister(), h)
+    py = check_history(VersionedRegister(), h, use_native=False)
+    assert out["valid?"] is py["valid?"] is False
+    # and the kernel packer refuses rather than mis-encoding
+    from jepsen_etcd_tpu.ops import wgl
+    p = wgl.pack_register_history(h)
+    assert not p.ok and "unsupported value" in p.reason
+
+
+def test_native_much_faster_on_deep_history():
+    """The point of the native engine: beat the Python DFS on the
+    heavy fallback regime. Sanity-check a speedup on a mid-size
+    history (not a benchmark, just an ordering assertion)."""
+    import time
+    rng = random.Random(17)
+    h = gen_history(rng, n_procs=8, n_ops=160, info_rate=0.1)
+    native.get_lib()  # build outside the timer
+    t0 = time.time()
+    nat = check_history(VersionedRegister(), h)
+    t_nat = time.time() - t0
+    t0 = time.time()
+    py = check_history(VersionedRegister(), h, use_native=False)
+    t_py = time.time() - t0
+    assert nat["valid?"] == py["valid?"]
+    assert t_nat < t_py, f"native {t_nat:.3f}s vs python {t_py:.3f}s"

@@ -131,7 +131,7 @@ def test_differential_random_histories(corrupt):
     for trial in range(FUZZ_TRIALS):
         h = gen_history(rng, n_procs=rng.randint(2, 5),
                         n_ops=rng.randint(8, 32), corrupt=corrupt)
-        cpu = check_history(VersionedRegister(), h)
+        cpu = check_history(VersionedRegister(), h, use_native=False)
         tpu = checker.check({}, h)
         if tpu["valid?"] == "unknown":
             continue
@@ -235,7 +235,7 @@ def test_info_pred_ordering():
         Op(type="invoke", process=2, f="read", value=[None, None]),
         Op(type="ok", process=2, f="read", value=[1, 2]),
     ])
-    cpu = check_history(VersionedRegister(), h)
+    cpu = check_history(VersionedRegister(), h, use_native=False)
     out = TPULinearizableChecker(fallback=False).check({}, h)
     assert cpu["valid?"] is False
     assert out["valid?"] is False
@@ -279,7 +279,7 @@ def test_differential_info_histories(corrupt):
         h = gen_history(rng, n_procs=rng.randint(2, 5),
                         n_ops=rng.randint(8, 28), corrupt=corrupt,
                         info_rate=0.3)
-        cpu = check_history(VersionedRegister(), h)
+        cpu = check_history(VersionedRegister(), h, use_native=False)
         tpu = checker.check({}, h)
         if tpu["valid?"] == "unknown" or cpu["valid?"] == "unknown":
             continue
@@ -321,7 +321,7 @@ def test_read_none_value_is_wildcard():
         Op(type="invoke", process=0, f="read", value=[None, None]),
         Op(type="ok", process=0, f="read", value=[1, None]),
     ])
-    cpu = check_history(VersionedRegister(), h)
+    cpu = check_history(VersionedRegister(), h, use_native=False)
     tpu = TPULinearizableChecker(fallback=False).check({}, h)
     assert cpu["valid?"] is True
     assert tpu["valid?"] is True
@@ -459,7 +459,7 @@ def test_differential_mutex(corrupt, info_rate):
         h = gen_mutex_history(rng, n_procs=rng.randint(2, 4),
                               n_ops=rng.randint(6, 24),
                               corrupt=corrupt, info_rate=info_rate)
-        cpu = check_history(Mutex(), h)
+        cpu = check_history(Mutex(), h, use_native=False)
         tpu = checker.check({}, h)
         if tpu["valid?"] == "unknown":
             continue
@@ -535,7 +535,7 @@ def test_differential_wide_histories():
         p = wgl.pack_register_history(h)
         if not p.ok:
             continue
-        cpu = check_history(VersionedRegister(), h)
+        cpu = check_history(VersionedRegister(), h, use_native=False)
         tpu = checker.check({}, h)
         if tpu["valid?"] == "unknown" or cpu["valid?"] == "unknown":
             continue
