@@ -55,9 +55,18 @@ W_MAX = 64      # two-word window width (high-overlap histories: long
                 # completions push the undecided window past 32)
 I_MAX = 32      # info-op capacity (one uint32 mask word)
 F_MAX = 512     # frontier capacity per wave (in-kernel mode)
-F_MAX_BIG = 4096  # top of the in-kernel retry ladder (128->512->4096);
-                # ~1k-frontier searches (e.g. 4n-concurrency register)
-                # stay on-device instead of paying host spill ping-pong
+F_MAX_BIG = 4096  # top of the in-kernel retry ladder; past this the
+                # host-driven spill BFS takes over
+# per-wave cost is dominated by the dedup sort of F*(w+i_pad)
+# candidates, so running above the needed capacity wastes time
+# proportionally. The ladder ascends geometrically and the search
+# settles at the smallest rung that fits its peak frontier (profiled
+# on the deep 4n/2000 register bench: peak 954, median wave 92 —
+# a 128->512->4096 ladder parked 97% of waves at 4096, 4x the cost
+# of the 1024 rung the search actually needed; healthy single-key
+# searches peak in the tens, so the ladder bottoms at 32 — the 10k-op
+# headline bench runs 1.8x faster there than at 128).
+LADDER = [32, 128, F_MAX, 1024, 2048, F_MAX_BIG]
 SENTINEL_D = np.int32(2 ** 31 - 1)
 SENTINEL_W = np.uint32(0xFFFFFFFF)
 SENTINEL_V = np.int32(2 ** 31 - 1)
@@ -848,7 +857,8 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
     for j, i in enumerate(idxs):
         p = packs[i]
         if overflow[j]:
-            # retry at full capacity, then spill — per key, off the batch
+            # climb the remaining ladder rungs, then spill — per key,
+            # off the batch
             results[i] = check_packed(p, f_max=F_MAX)
         else:
             v = bool(valid[j])
@@ -864,10 +874,10 @@ def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
 
     f_max defaults small (tiny sorts, fast waves — healthy frontiers
     peak in the tens). On overflow the frozen pre-expansion frontier
-    RESUMES at the next capacity rung (512, then 4096) — earlier waves
-    are never redone, and waves only pay for big sorts while the
-    frontier is actually big. Past 4096 the host-driven chunked spill
-    BFS takes over from the same frontier.
+    RESUMES at the next LADDER rung (32 -> ... -> 4096) — earlier waves
+    are never redone, and the search settles at the smallest rung that
+    fits its peak frontier. Past the top rung the host-driven chunked
+    spill BFS takes over from the same frontier.
     """
     import jax.numpy as jnp
 
@@ -879,9 +889,9 @@ def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
     # f_max (when given) is the STARTING rung; the ladder still
     # escalates past it on overflow before spilling
     if f_max is None:
-        ladder = [128, F_MAX, F_MAX_BIG]
+        ladder = LADDER
     else:
-        ladder = [f_max] + [f for f in (F_MAX, F_MAX_BIG) if f > f_max]
+        ladder = [f_max] + [f for f in LADDER if f > f_max]
     i_pad = bucket_i(p.I)
     tables = {k: jnp.asarray(v)
               for k, v in pad_tables(p, bucket(p.R), i_pad).items()}
