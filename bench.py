@@ -190,6 +190,8 @@ def bench_watch():
 
 
 def main() -> int:
+    from jepsen_etcd_tpu.ops.common import enable_compile_cache
+    enable_compile_cache()
     matrix = {}
     for name, fn in [("register_100", bench_register_100),
                      ("deep_wgl_4n_2000", bench_deep_wgl),

@@ -41,7 +41,7 @@ class TPULinearizableChecker(Checker):
             return wgl.pack_mutex_history
         return None
 
-    def _finalize(self, history, out: dict) -> dict:
+    def _finalize(self, history, out: dict, pack=None) -> dict:
         """Post-process one kernel verdict into a checker result,
         attaching CPU counterexample diagnostics / fallback as needed."""
         if out["valid?"] is True:
@@ -57,8 +57,32 @@ class TPULinearizableChecker(Checker):
                 if k in cpu:
                     out[k] = cpu[k]
             return out
+        if out.get("overflow") and pack is not None:
+            return self._overflow(history, pack, out)
         return self._fallback(history, out.get("reason", "unknown"),
                               blowup=bool(out.get("blowup")))
+
+    def _overflow(self, history, pack, out: dict) -> dict:
+        """Top-rung frontier overflow: a DFS needs only one witness
+        path where the BFS carries the whole frontier, so the (native)
+        CPU oracle goes first; the budgeted spill BFS remains the
+        *complete* last resort when the DFS exhausts its budget."""
+        from ..ops import wgl
+        resume = out.pop("_resume", None)
+        cpu = self._fallback(history, out.get("reason", "overflow"))
+        if cpu["valid?"] != "unknown":
+            return cpu
+        if resume is not None:
+            # resume the spill from the frozen frontier — the ladder
+            # waves already run are never redone
+            out2 = wgl.spill_packed(pack, *resume)
+        else:
+            out2 = wgl.check_packed(pack, f_max=self.f_max, spill=True)
+        if out2["valid?"] == "unknown":
+            out2["checker"] = "tpu-wgl"
+            out2["dfs-also-unknown"] = True
+            return out2
+        return self._finalize(history, out2)
 
     def _fallback(self, history, reason: str,
                   blowup: bool = False) -> dict:
@@ -85,7 +109,11 @@ class TPULinearizableChecker(Checker):
         p = pack(history)
         if not p.ok:
             return self._fallback(history, p.reason, blowup=p.blowup)
-        return self._finalize(history, wgl.check_packed(p, f_max=self.f_max))
+        # with a fallback available, defer the spill BFS until the DFS
+        # has had its (cheaper) shot — see _overflow
+        out = wgl.check_packed(p, f_max=self.f_max,
+                               spill=not self.fallback)
+        return self._finalize(history, out, pack=p)
 
     def check_batch(self, test, subhistories: dict, opts=None) -> dict:
         """Check many per-key histories in one vmapped, mesh-sharded
@@ -100,9 +128,10 @@ class TPULinearizableChecker(Checker):
         packs = [pack(subhistories[k]) for k in keys]
         outs = wgl.check_packed_batch(packs, f_max=self.f_max)
         # unpackable keys come back "unknown" with the pack reason;
-        # _finalize routes those through the CPU fallback
-        return {k: self._finalize(subhistories[k], out)
-                for k, out in zip(keys, outs)}
+        # _finalize routes those through the CPU fallback (and top-rung
+        # overflows through the DFS-then-spill ordering)
+        return {k: self._finalize(subhistories[k], out, pack=p)
+                for (k, out, p) in zip(keys, outs, packs)}
 
 
 def tpu_linearizable(model_fn=None) -> TPULinearizableChecker:

@@ -130,6 +130,8 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    from .ops.common import enable_compile_cache
+    enable_compile_cache()
     args = build_parser().parse_args(argv)
     if args.command == "serve":
         from .serve import serve_store

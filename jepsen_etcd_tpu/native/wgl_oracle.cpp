@@ -131,80 +131,121 @@ extern "C" int32_t wgl_oracle_check(
     req_order[j] = v;
   }
 
+  // enabled-candidate prefix masks: pre[r] = entries with inv < the
+  // r-th-by-ret required op's ret. The per-config candidate walk then
+  // iterates only (pre[min_ret_op] & ~mask) set bits — O(n/64 + #cand)
+  // instead of an O(n) scan, the difference between 0.2M and 2M
+  // configs/s on 2000-entry histories.
+  std::vector<size_t> rank_of(n, 0);  // entry -> index in req_order
+  for (size_t r = 0; r < req_order.size(); r++) rank_of[req_order[r]] = r;
+  std::vector<uint64_t> pre(req_order.size() * nw, 0);
+  for (size_t r = 0; r < req_order.size(); r++) {
+    const int64_t bound = ret[req_order[r]];
+    uint64_t* row = &pre[r * nw];
+    for (int32_t e = 0; e < n; e++)
+      if (inv[e] < bound) row[e >> 6] |= 1ULL << (e & 63);
+  }
+
+  // frame layout: [mask: nw][value<<32|version: 1][pmask: nw_req]
+  // pmask mirrors the mask permuted into ret-rank order over required
+  // ops (bits >= Rreq pre-set), so the min-ret scan is a word-wise
+  // first-zero search instead of an O(depth) bit walk. The visited key
+  // is the fw-word prefix only — pmask is a function of the mask.
+  const size_t n_req = req_order.size();
+  const size_t nw_req = (n_req + 63) / 64;
+  const size_t fs = fw + nw_req;  // full stack-frame width
+
   KeySet visited;
   visited.init(fw, 1 << 16);
   std::vector<uint64_t> stack;  // frames, popped from the back
-  stack.assign(fw, 0);          // initial: empty mask, value 0, version 0
+  stack.assign(fs, 0);          // initial: empty mask, value 0, version 0
+  for (size_t b = n_req; b < nw_req * 64; b++)
+    stack[fw + (b >> 6)] |= 1ULL << (b & 63);
 
   int64_t configs = 0;
   int32_t best_depth = -1, blocked_op = -1;
   int32_t blocked_version = 0, blocked_value = 0;
-  std::vector<uint64_t> frame(fw), child(fw);
+  std::vector<uint64_t> frame(fs), child(fs);
 
+  visited.insert(stack.data());  // dedup happens at PUSH time: a state
+  // reachable through many parents is stacked (and its frame copied)
+  // only once, instead of being pushed repeatedly and discarded on pop
   while (!stack.empty()) {
-    std::memcpy(frame.data(), stack.data() + stack.size() - fw, fw * 8);
-    stack.resize(stack.size() - fw);
-    if (!visited.insert(frame.data())) continue;
+    std::memcpy(frame.data(), stack.data() + stack.size() - fs, fs * 8);
+    stack.resize(stack.size() - fs);
     if (++configs > max_configs) {
       *configs_out = configs;
       return 2;
     }
     const uint64_t* m = frame.data();
+    const uint64_t* pm = frame.data() + fw;
     const int32_t value = static_cast<int32_t>(frame[nw] >> 32);
     const int32_t version =
         static_cast<int32_t>(frame[nw] & 0xffffffffULL);
 
-    int64_t min_ret = INT64_MAX;
-    for (int32_t idx : req_order) {
-      if (!get_bit(m, idx)) {
-        min_ret = ret[idx];
+    size_t r_min = n_req;  // rank of the first unlinearized required op
+    for (size_t w = 0; w < nw_req; w++) {
+      if (pm[w] != ~0ULL) {
+        r_min = (w << 6) + __builtin_ctzll(~pm[w]);
         break;
       }
     }
-    if (min_ret == INT64_MAX) {  // every required op linearized
+    if (r_min >= n_req) {  // every required op linearized
       *configs_out = configs;
       *blocked_version_out = version;
       *blocked_value_out = value;
       return 1;
     }
 
-    for (int32_t e = 0; e < n; e++) {
-      if (get_bit(m, e)) continue;
-      if (inv[e] >= min_ret) continue;
-      if (sym_pred[e] >= 0 && !get_bit(m, sym_pred[e])) continue;
-      bool ok;
-      int32_t nval;
-      if (f[e] == F_READ) {
-        ok = (ver[e] == NO_ASSERT || ver[e] == version) &&
-             (a1[e] == WILDCARD || a1[e] == value);
-        nval = value;
-      } else if (f[e] == F_WRITE) {
-        ok = (ver[e] == NO_ASSERT || ver[e] == version + 1);
-        nval = a1[e];
-      } else {
-        ok = (ver[e] == NO_ASSERT || ver[e] == version + 1) &&
-             a1[e] == value;
-        nval = a2[e];
-      }
-      if (!ok) {
-        if (req[e]) {
-          int32_t d = 0;
-          for (size_t w = 0; w < nw; w++) d += __builtin_popcountll(m[w]);
-          if (d >= best_depth) {
-            best_depth = d;
-            blocked_op = e;
-            blocked_version = version;
-            blocked_value = value;
-          }
+    const uint64_t* enabled = &pre[r_min * nw];
+    for (size_t w = 0; w < nw; w++) {
+      uint64_t cand = enabled[w] & ~m[w];
+      while (cand) {
+        const int32_t e =
+            static_cast<int32_t>((w << 6) + __builtin_ctzll(cand));
+        cand &= cand - 1;
+        if (sym_pred[e] >= 0 && !get_bit(m, sym_pred[e])) continue;
+        bool ok;
+        int32_t nval;
+        if (f[e] == F_READ) {
+          ok = (ver[e] == NO_ASSERT || ver[e] == version) &&
+               (a1[e] == WILDCARD || a1[e] == value);
+          nval = value;
+        } else if (f[e] == F_WRITE) {
+          ok = (ver[e] == NO_ASSERT || ver[e] == version + 1);
+          nval = a1[e];
+        } else {
+          ok = (ver[e] == NO_ASSERT || ver[e] == version + 1) &&
+               a1[e] == value;
+          nval = a2[e];
         }
-        continue;
+        if (!ok) {
+          if (req[e]) {
+            int32_t d = 0;
+            for (size_t ww = 0; ww < nw; ww++)
+              d += __builtin_popcountll(m[ww]);
+            if (d >= best_depth) {
+              best_depth = d;
+              blocked_op = e;
+              blocked_version = version;
+              blocked_value = value;
+            }
+          }
+          continue;
+        }
+        const int32_t nver = (f[e] == F_READ) ? version : version + 1;
+        std::memcpy(child.data(), frame.data(), fs * 8);
+        child[e >> 6] |= 1ULL << (e & 63);
+        child[nw] =
+            (static_cast<uint64_t>(static_cast<uint32_t>(nval)) << 32) |
+            static_cast<uint32_t>(nver);
+        if (req[e]) {
+          const size_t r = rank_of[e];
+          child[fw + (r >> 6)] |= 1ULL << (r & 63);
+        }
+        if (visited.insert(child.data()))
+          stack.insert(stack.end(), child.begin(), child.end());
       }
-      const int32_t nver = (f[e] == F_READ) ? version : version + 1;
-      std::memcpy(child.data(), m, nw * 8);
-      child[e >> 6] |= 1ULL << (e & 63);
-      child[nw] = (static_cast<uint64_t>(static_cast<uint32_t>(nval)) << 32) |
-                  static_cast<uint32_t>(nver);
-      stack.insert(stack.end(), child.begin(), child.end());
     }
   }
 

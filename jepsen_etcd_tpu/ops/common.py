@@ -16,6 +16,40 @@ except Exception:  # pragma: no cover - jax is baked in
     HAVE_JAX = False
 
 
+import os
+
+_CACHE_ON = False
+
+
+def enable_compile_cache(path: str | None = None) -> None:
+    """Point XLA's persistent compilation cache at a repo-local dir so
+    kernel compiles (W=128 wave loops run minutes of XLA time) amortize
+    across processes — the CLI, bench, tests, and the graft entry all
+    call this. No-op if jax is absent or
+    JEPSEN_ETCD_TPU_NO_COMPILE_CACHE is set."""
+    global _CACHE_ON
+    if _CACHE_ON or os.environ.get("JEPSEN_ETCD_TPU_NO_COMPILE_CACHE") \
+            or not HAVE_JAX:
+        return
+    try:
+        import jax
+        if jax.default_backend() == "cpu":
+            # XLA:CPU AOT cache entries pin host machine features and
+            # can SIGILL when reloaded under different flags; CPU
+            # compiles are cheap, so cache only accelerator backends
+            return
+        if path is None:
+            path = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+                ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+        _CACHE_ON = True
+    except Exception:  # cache is an optimization, never a failure
+        pass
+
+
 class UnsupportedValue(Exception):
     """An op value the dense encodings can't represent faithfully;
     callers fall back to the Python oracle."""

@@ -5,9 +5,9 @@ Replaces Knossos' CPU Wing-Gong/Lowe search (reference binding at
 insight making the search TPU-shaped: in a history with bounded
 concurrency, sort the must-linearize (:ok) ops by invocation; then any
 reachable "linearized set" consists of a *forced prefix* plus a bitmask
-over a sliding window of at most W undecided ops (W auto-selects 32 —
-one uint32 word — or 64 — two words — per history). A search state
-packs to
+over a sliding window of at most W undecided ops (W auto-selects 32,
+64, or 128 — one, two, or four uint32 words — per history). A search
+state packs to
 
     (depth d, window mask words, uint32 info mask, model value id)
 
@@ -50,9 +50,11 @@ from ..checkers.linearizable import Entry, history_entries
 from .common import UnsupportedValue, ValueIds, as_version
 
 W = 32          # single-word window width (fast path)
-W_MAX = 64      # two-word window width (high-overlap histories: long
-                # blocked ops — e.g. lock acquires — spanning many
-                # completions push the undecided window past 32)
+W_MAX = 128     # widest window the kernel packs (4 uint32 words).
+                # High-overlap histories — long blocked ops (e.g. lock
+                # acquires) spanning many completions, or 8n+
+                # concurrency — push the undecided window past 32;
+                # width auto-selects 32/64/128 per history.
 I_MAX = 32      # info-op capacity (one uint32 mask word)
 F_MAX = 512     # frontier capacity per wave (in-kernel mode)
 F_MAX_BIG = 4096  # top of the in-kernel retry ladder; past this the
@@ -91,12 +93,16 @@ SPILL_I_LIMIT = 24
 SPILL_STATE_BUDGET_HIGH_I = 1_000_000
 
 
-def split_words(m64: np.ndarray, nw: int) -> np.ndarray:
-    """Split uint64 masks into nw little-endian uint32 words (new
-    trailing axis)."""
-    lo = (m64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    hi = (m64 >> np.uint64(32)).astype(np.uint32)
-    return np.stack([lo, hi], axis=-1)[..., :nw]
+def pack_bits(bits: np.ndarray, nw: int) -> np.ndarray:
+    """Pack a trailing bool axis of width w = 32*nw into nw
+    little-endian uint32 words (new trailing axis replaces it)."""
+    w = bits.shape[-1]
+    assert w <= 32 * nw
+    padded = np.zeros(bits.shape[:-1] + (32 * nw,), dtype=np.uint32)
+    padded[..., :w] = bits
+    b32 = (np.uint32(1) << np.arange(32, dtype=np.uint32))
+    return (padded.reshape(bits.shape[:-1] + (nw, 32)) * b32).sum(
+        -1, dtype=np.uint32)
 
 
 @dataclass
@@ -109,7 +115,7 @@ class Packed:
     R: int = 0
     I: int = 0
     n_values: int = 0
-    w: int = W      # window width (32 single-word / 64 two-word)
+    w: int = W      # window width (32 / 64 / 128 = 1, 2, 4 words)
     # required tables ([R, W] unless noted; NW = w // 32 little-endian
     # uint32 mask words on the trailing axis)
     shift: Any = None         # [R] int32
@@ -291,8 +297,8 @@ def _pack_register_history(history, i_max: int, adapter) -> Packed:
             p += 1
         lo[d] = p
     # feasibility: window must hold all set bits and all enabled
-    # candidates. Histories needing >32 bits get the two-word (W=64)
-    # kernel variant; >64 is beyond the kernel.
+    # candidates. Histories needing >32 bits get the wider multi-word
+    # kernel variants (W=64/128); >128 is beyond the kernel.
     width_bits = np.max(np.arange(R + 1) - lo) if R else 0
     first_lo = lo[np.minimum(pred, R)]
     width_cand = np.max(np.arange(R) - first_lo) + 1 if R else 0
@@ -301,7 +307,7 @@ def _pack_register_history(history, i_max: int, adapter) -> Packed:
         return Packed(ok=False,
                       reason=f"window {width} > {W_MAX} "
                              f"(concurrency too high for kernel)")
-    w = W if width <= W else W_MAX
+    w = next(c for c in (W, 64, W_MAX) if width <= c)
     nw = w // 32
 
     d_idx = np.arange(R)[:, None]                       # [R, 1]
@@ -311,20 +317,17 @@ def _pack_register_history(history, i_max: int, adapter) -> Packed:
     static_ok = in_range & (pred[idx] <= d_idx)
 
     # predecessor bits within the frame: bit c <-> rank lo[d]+c. Masks
-    # build as uint64 then split into nw little-endian uint32 words
-    # (trailing axis) — TPUs have no native 64-bit ints.
+    # pack into nw little-endian uint32 words (trailing axis) — TPUs
+    # have no native 64-bit ints, and W=128 exceeds uint64 anyway.
     ret_frame = ret[idx]                                      # [R, W]
     inv_cand = inv[idx]                                       # [R, W]
     is_pred = (ret_frame[:, None, :] < inv_cand[:, :, None])  # [R, W, W]
     in_range_c = in_range[:, None, :]                         # [R, 1, W]
-    bits = (1 << np.arange(w, dtype=np.uint64))
-    pred_frame = split_words(
-        ((is_pred & in_range_c) * bits).sum(-1, dtype=np.uint64), nw)
+    pred_frame = pack_bits(is_pred & in_range_c, nw)
 
     is_upd = (f == WRITE) | (f == CAS)
     upd_frame = is_upd[idx] & in_range
-    upd_mask = split_words(
-        (upd_frame * bits).sum(-1, dtype=np.uint64), nw)
+    upd_mask = pack_bits(upd_frame, nw)
     cum_upd = np.concatenate([[0], np.cumsum(is_upd)])
     u_forced = cum_upd[lo[:R]].astype(np.int32)
 
@@ -335,9 +338,8 @@ def _pack_register_history(history, i_max: int, adapter) -> Packed:
     if I:
         pred_in_win = in_range[:, :, None] & \
             (ret_frame[:, :, None] < i_inv[None, None, :])    # [R, W, I]
-        ipred_frame = split_words(
-            (pred_in_win * bits[None, :, None]).sum(
-                1, dtype=np.uint64), nw)                      # [R, I, NW]
+        ipred_frame = pack_bits(
+            np.swapaxes(pred_in_win, 1, 2), nw)               # [R, I, NW]
         pf = (ret[:, None] < i_inv[None, :])                  # [R, I]
         C = np.concatenate([np.zeros((1, I), dtype=np.int64),
                             np.cumsum(pf, axis=0)])           # [R+1, I]
@@ -378,7 +380,7 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
     f_in = dvec.shape[0]
     nw = wvec.shape[1]                 # mask words (1: W<=32, 2: W<=64)
     # static one-hot candidate-bit table: B[b, wi] = bit (b%32) of word
-    # b//32 — little-endian words, same layout split_words produces
+    # b//32 — little-endian words, same layout pack_bits produces
     B_np = np.zeros((w, nw), dtype=np.uint32)
     for b in range(w):
         B_np[b, b // 32] = np.uint32(1) << np.uint32(b % 32)
@@ -439,26 +441,28 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
 
     def rshift_words(words, s):
         """words: list of NW [..., ] uint32 planes; s broadcastable
-        shift in [0, 32*nw]. Returns the shifted planes."""
+        shift in [0, 32*nw]. Returns the shifted planes. Generic over
+        nw: decompose s = 32*k + r, select source planes i+k / i+k+1
+        by a where-chain over the (static, <= nw) possible k values,
+        and combine with clamped lane shifts (no lane ever shifts by
+        >= 32, which would be UB)."""
         s32 = s.astype(jnp.uint32)
-        ssafe = jnp.minimum(s32, jnp.uint32(31))
-        if nw == 1:
-            return [jnp.where(s32 >= 32, jnp.uint32(0),
-                              words[0] >> ssafe)]
-        w0, w1 = words
-        s2 = jnp.where(s32 >= 32, s32 - 32, jnp.uint32(0))
-        s2safe = jnp.minimum(s2, jnp.uint32(31))
-        # clamp the carry amount too: 32 - ssafe == 32 when ssafe == 0
-        # (result discarded by the where, but the lane must not shift
-        # by >= 32)
-        carry_amt = jnp.minimum(jnp.uint32(32) - ssafe, jnp.uint32(31))
-        carry = jnp.where(ssafe == jnp.uint32(0), jnp.uint32(0),
-                          w1 << carry_amt)
-        lo_small = (w0 >> ssafe) | carry
-        lo_big = jnp.where(s2 >= 32, jnp.uint32(0), w1 >> s2safe)
-        out0 = jnp.where(s32 >= 32, lo_big, lo_small)
-        out1 = jnp.where(s32 >= 32, jnp.uint32(0), w1 >> ssafe)
-        return [out0, out1]
+        k = s32 >> 5                          # word offset, 0..nw
+        r = s32 & jnp.uint32(31)              # bit offset within word
+        rsafe = jnp.minimum(r, jnp.uint32(31))
+        carry_amt = jnp.minimum(jnp.uint32(32) - rsafe, jnp.uint32(31))
+        zero = jnp.zeros_like(words[0])
+        padded = list(words) + [zero] * (nw + 1)
+        out = []
+        for i in range(nw):
+            lo_w = zero
+            hi_w = zero
+            for kk in range(nw + 1):
+                lo_w = jnp.where(k == kk, padded[i + kk], lo_w)
+                hi_w = jnp.where(k == kk, padded[i + kk + 1], hi_w)
+            carry = jnp.where(r == 0, jnp.uint32(0), hi_w << carry_amt)
+            out.append((lo_w >> rsafe) | carry)
+        return out
 
     shifted = rshift_words([new_w[:, :, wi] for wi in range(nw)], s_amt)
     new_w = jnp.stack(shifted, axis=-1)                    # [F, W, NW]
@@ -534,14 +538,12 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
 
 
 @functools.lru_cache(maxsize=None)
-def _kernel_jitted(f_max: int, w: int, i_pad: int):
-    import jax
-    return jax.jit(functools.partial(_wgl_kernel, f_max=f_max, w=w,
-                                     i_pad=i_pad))
-
-
-@functools.lru_cache(maxsize=None)
 def _kernel_resume_jitted(f_max: int, w: int, i_pad: int):
+    """The ONE jitted wave-loop form per rung. Fresh searches seed the
+    initial frontier on the host and enter through the same resume
+    signature, so each (f_max, w, i_pad) shape compiles exactly once —
+    wide-window (W=128) compiles are expensive enough that a separate
+    fresh-start compile per rung would double a multi-minute bill."""
     import jax
 
     def run(tables, R, I, k0, d0, w0, i0, v0, n0):
@@ -678,8 +680,22 @@ def _expand_jitted(f_in: int, w: int, i_pad: int, f_out: int):
     return jax.jit(run)
 
 
+SPILL_WALL_BUDGET_S = 60.0  # hopeless-width searches must fail fast
+
+
+def spill_packed(p: Packed, tables, frontier, waves_done: int) -> dict:
+    """Budgeted host-spill continuation from a frozen frontier — the
+    entry point for resuming a ``check_packed(..., spill=False)``
+    overflow (its ``_resume`` payload) without re-climbing the ladder."""
+    return _spill_bfs(p, tables, frontier, waves_done,
+                      state_budget=SPILL_STATE_BUDGET
+                      if p.I < SPILL_I_LIMIT
+                      else SPILL_STATE_BUDGET_HIGH_I)
+
+
 def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
-               state_budget: int = SPILL_STATE_BUDGET) -> dict:
+               state_budget: int = SPILL_STATE_BUDGET,
+               wall_budget_s: float = SPILL_WALL_BUDGET_S) -> dict:
     """Host-driven chunked BFS after in-kernel frontier overflow.
 
     The frontier lives on host as numpy arrays; each wave expands it in
@@ -697,7 +713,10 @@ def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
 
     i_pad = bucket_i(p.I)
     nw = p.w // 32
-    f_in = SPILL_CHUNK
+    # W=128: a full-size chunk would make the lossless-output sort
+    # (f_in * 129 slots) prohibitively slow to compile; spill there is
+    # a last resort behind the DFS anyway
+    f_in = SPILL_CHUNK if p.w < W_MAX else 1024
     f_out = f_in * (p.w + max(i_pad, 1))
     expand = _expand_jitted(f_in, p.w, i_pad, f_out)
     dvec, wvec, ivec, vvec, n_alive = [np.asarray(x) for x in frontier]
@@ -707,11 +726,26 @@ def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
          wvec[:n].astype(np.int64).reshape(n, nw),
          ivec[:n, None].astype(np.int64),
          vvec[:n, None].astype(np.int64)], axis=1)  # [n, 3 + nw]
+    import time as _time
+    # compile warmup outside the wall budget: an all-sentinel chunk is
+    # a no-op wave, but it forces the (expensive, possibly minutes for
+    # W=128) expand compile so the budget measures search, not XLA
+    expand(jnp.full((f_in,), SENTINEL_D, dtype=jnp.int32),
+           jnp.full((f_in, nw), SENTINEL_W, dtype=jnp.uint32),
+           jnp.zeros((f_in,), dtype=jnp.uint32),
+           jnp.full((f_in,), SENTINEL_V, dtype=jnp.int32),
+           tables, jnp.int32(p.R), jnp.int32(p.I))
+    t_start = _time.monotonic()
     states_total = n
     peak = n
     waves = waves_done
     max_waves = p.R + p.I + 1
     while fr.shape[0] and waves < max_waves:
+        if _time.monotonic() - t_start > wall_budget_s:
+            return {"valid?": "unknown", "blowup": True,
+                    "reason": f"spill wall budget {wall_budget_s:.0f}s "
+                              "exceeded",
+                    "peak-frontier": peak, "spilled": True}
         succs = []
         for s in range(0, fr.shape[0], f_in):
             chunk = fr[s:s + f_in]
@@ -787,7 +821,9 @@ def check_packed_batch(packs: list, f_max: Optional[int] = None) -> list:
     key neither inflates every key's padded tables nor forces cold keys
     through its wave count (while_loop under vmap runs until the slowest
     batch element finishes). Per-key overflow falls out of the batch and
-    retries/spills through ``check_packed``.
+    climbs the remaining ladder rungs through ``check_packed``; spill is
+    deferred (``{"overflow": True}`` result) so the calling checker can
+    interpose its cheaper DFS first.
 
     Returns one result dict per pack, aligned with the input order.
     """
@@ -816,7 +852,8 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
     import jax.numpy as jnp
 
     if len(idxs) == 1:
-        results[idxs[0]] = check_packed(packs[idxs[0]], f_max=f_max)
+        results[idxs[0]] = check_packed(packs[idxs[0]], f_max=f_max,
+                                        spill=False)
         return
     if f_max is None:
         f_max = 128
@@ -857,9 +894,11 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
     for j, i in enumerate(idxs):
         p = packs[i]
         if overflow[j]:
-            # climb the remaining ladder rungs, then spill — per key,
-            # off the batch
-            results[i] = check_packed(p, f_max=F_MAX)
+            # climb the remaining ladder rungs — per key, off the
+            # batch; spill is deferred so the checker can interpose
+            # its cheaper DFS on top-rung overflow (see
+            # TPULinearizableChecker._overflow)
+            results[i] = check_packed(p, f_max=F_MAX, spill=False)
         else:
             v = bool(valid[j])
             results[i] = {
@@ -869,7 +908,8 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
                 **({} if v else {"stuck-at-depth": int(waves[j])})}
 
 
-def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
+def check_packed(p: Packed, f_max: Optional[int] = None,
+                 spill: bool = True) -> dict:
     """Run the kernel on one packed history (host->device->host).
 
     f_max defaults small (tiny sorts, fast waves — healthy frontiers
@@ -877,7 +917,11 @@ def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
     RESUMES at the next LADDER rung (32 -> ... -> 4096) — earlier waves
     are never redone, and the search settles at the smallest rung that
     fits its peak frontier. Past the top rung the host-driven chunked
-    spill BFS takes over from the same frontier.
+    spill BFS takes over from the same frontier — unless ``spill=False``,
+    which instead returns ``{"valid?": "unknown", "overflow": True}``
+    so the caller can try a cheaper engine first (a DFS needs one
+    witness path where this BFS carries the whole frontier; see
+    TPULinearizableChecker's fallback ordering).
     """
     import jax.numpy as jnp
 
@@ -892,13 +936,30 @@ def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
         ladder = LADDER
     else:
         ladder = [f_max] + [f for f in LADDER if f > f_max]
+    if p.w == W_MAX:
+        # W=128 kernels compile slowly and their overflows are almost
+        # always combinatorial blowup: cap the in-kernel ladder and let
+        # the DFS-first overflow path (TPULinearizableChecker._overflow)
+        # take it from there
+        ladder = [f for f in ladder if f <= F_MAX] or [ladder[0]]
     i_pad = bucket_i(p.I)
     tables = {k: jnp.asarray(v)
               for k, v in pad_tables(p, bucket(p.R), i_pad).items()}
     R_, I_ = jnp.int32(p.R), jnp.int32(p.I)
     peak_all = 1
-    valid, overflow, k, peak, frontier = _kernel_jitted(
-        ladder[0], p.w, i_pad)(tables, R_, I_)
+    nw = p.w // 32
+    d0 = np.full((ladder[0],), SENTINEL_D, dtype=np.int32)
+    d0[0] = 0
+    w0 = np.full((ladder[0], nw), SENTINEL_W, dtype=np.uint32)
+    w0[0] = 0
+    i0 = np.zeros((ladder[0],), dtype=np.uint32)
+    v0 = np.full((ladder[0],), SENTINEL_V, dtype=np.int32)
+    v0[0] = NONE_VAL
+    valid, overflow, k, peak, frontier = _kernel_resume_jitted(
+        ladder[0], p.w, i_pad)(tables, R_, I_, jnp.int32(0),
+                               jnp.asarray(d0), jnp.asarray(w0),
+                               jnp.asarray(i0), jnp.asarray(v0),
+                               jnp.int32(1))
     peak_all = max(peak_all, int(peak))
     for f_next in ladder[1:]:
         if not bool(overflow):
@@ -920,10 +981,16 @@ def check_packed(p: Packed, f_max: Optional[int] = None) -> dict:
         peak_all = max(peak_all, int(peak))
     valid = bool(valid)
     if bool(overflow):
-        out = _spill_bfs(p, tables, frontier, int(k),
-                         state_budget=SPILL_STATE_BUDGET
-                         if p.I < SPILL_I_LIMIT
-                         else SPILL_STATE_BUDGET_HIGH_I)
+        if not spill:
+            # hand back the frozen frontier so the caller's eventual
+            # spill RESUMES here instead of re-climbing the ladder
+            # (earlier waves are never redone — module contract)
+            return {"valid?": "unknown", "overflow": True,
+                    "reason": "frontier overflow past the top rung",
+                    "peak-frontier": peak_all, "ops": p.R,
+                    "info-ops": p.I,
+                    "_resume": (tables, frontier, int(k))}
+        out = spill_packed(p, tables, frontier, int(k))
         out["peak-frontier"] = max(peak_all, out.get("peak-frontier", 0))
         return out
     return {"valid?": valid, "waves": int(k), "peak-frontier": peak_all,

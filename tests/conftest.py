@@ -24,3 +24,6 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# NOTE: no enable_compile_cache() here — it would initialize backends
+# (breaking the jax_num_cpu_devices update above) and is a no-op on the
+# cpu backend anyway

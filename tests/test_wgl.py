@@ -377,6 +377,32 @@ def test_spill_invalid_verdict_past_fmax():
     assert out.get("spilled"), out
 
 
+def test_spill_resumes_from_frozen_frontier():
+    """check_packed(spill=False) hands back the frozen frontier; spilling
+    from it must reach the same verdicts as the integrated spill, without
+    re-climbing the ladder."""
+    for read_val, expect in ((1, True), (9, False)):
+        h = _concurrent_writes_history(16, read_val=read_val)
+        p = wgl.pack_register_history(h)
+        out = wgl.check_packed(p, spill=False)
+        assert out["valid?"] == "unknown" and out.get("overflow"), out
+        resumed = wgl.spill_packed(p, *out["_resume"])
+        assert resumed["valid?"] is expect, (read_val, resumed)
+        assert resumed.get("spilled"), resumed
+
+
+def test_overflow_prefers_dfs_before_spill():
+    """With a fallback available, top-rung overflow routes to the DFS
+    (one witness path) before the exhaustive spill BFS: a hopelessly
+    wide valid history answers fast via cpu-oracle instead of grinding
+    through a multi-million-state frontier."""
+    h = _concurrent_writes_history(24, read_val=1)  # C(24,12) ~ 2.7M
+    out = TPULinearizableChecker().check({}, h)
+    assert out["valid?"] is True, out
+    assert out["checker"] == "cpu-oracle", out
+    assert "overflow" in out.get("tpu-fallback-reason", ""), out
+
+
 def test_unsupported_model_goes_to_cpu():
     # a model state the kernel has no packing for (non-default initial
     # register) must take the sound CPU path; Mutex itself now packs
@@ -513,9 +539,49 @@ def test_wide_window_invalid():
     assert out["valid?"] is False, out
 
 
-def test_window_past_64_rejected():
-    p = wgl.pack_register_history(_wide_window_history(70))
+def test_window_past_64_uses_w128():
+    h = _wide_window_history(70)
+    p = wgl.pack_register_history(h)
+    assert p.ok and p.w == 128, (p.ok, p.reason, p.w)
+    out = TPULinearizableChecker(fallback=False).check({}, h)
+    assert out["valid?"] is True, out
+    assert out["checker"] == "tpu-wgl"
+    bad = TPULinearizableChecker(fallback=False).check(
+        {}, _wide_window_history(70, bad=True))
+    assert bad["valid?"] is False, bad
+
+
+def test_window_past_128_rejected():
+    p = wgl.pack_register_history(_wide_window_history(140))
     assert not p.ok and "window" in p.reason
+
+
+def test_differential_w128():
+    """Histories stretched past window 64 run the four-word kernel and
+    agree with the Python oracle."""
+    rng = random.Random(777)
+    checker = TPULinearizableChecker(fallback=False)
+    definitive = 0
+    for trial in range(15):
+        base = gen_history(rng, n_procs=4, n_ops=rng.randint(68, 100),
+                           corrupt=(trial % 2 == 1))
+        long_op = Op(type="invoke", process=99, f="write",
+                     value=[None, 3])
+        ops = [long_op] + list(base) + [
+            Op(type="ok", process=99, f="write", value=[None, 3])]
+        h = History([o.evolve(index=None) for o in ops])
+        p = wgl.pack_register_history(h)
+        if not p.ok or p.w != 128:
+            continue
+        cpu = check_history(VersionedRegister(), h, use_native=False)
+        tpu = checker.check({}, h)
+        if tpu["valid?"] == "unknown" or cpu["valid?"] == "unknown":
+            continue
+        definitive += 1
+        assert tpu["valid?"] == cpu["valid?"], (
+            f"trial {trial} (w={p.w}): kernel={tpu} "
+            f"oracle={cpu['valid?']}\n" + h.to_jsonl())
+    assert definitive >= 8, f"only {definitive}/15 definitive"
 
 
 def test_differential_wide_histories():
