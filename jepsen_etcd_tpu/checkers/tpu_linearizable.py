@@ -78,11 +78,13 @@ class TPULinearizableChecker(Checker):
             out2 = wgl.spill_packed(pack, *resume)
         else:
             out2 = wgl.check_packed(pack, f_max=self.f_max, spill=True)
+        # no _finalize here: the DFS just exhausted its budget, so
+        # re-running it for counterexample diagnostics would duplicate
+        # that cost and stamp its budget error onto a sound verdict
+        out2["checker"] = "tpu-wgl"
         if out2["valid?"] == "unknown":
-            out2["checker"] = "tpu-wgl"
             out2["dfs-also-unknown"] = True
-            return out2
-        return self._finalize(history, out2)
+        return out2
 
     def _fallback(self, history, reason: str,
                   blowup: bool = False) -> dict:

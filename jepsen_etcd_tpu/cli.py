@@ -130,12 +130,13 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
-    from .ops.common import enable_compile_cache
-    enable_compile_cache()
     args = build_parser().parse_args(argv)
     if args.command == "serve":
         from .serve import serve_store
         return serve_store(args.store, args.port, args.bind)
+    # kernel-running commands only: initializes the jax backend
+    from .ops.common import enable_compile_cache
+    enable_compile_cache()
     if args.command == "test":
         opts = opts_from_args(args)
         ok = True

@@ -17,6 +17,7 @@ runtime scalars, so one compiled kernel serves all logs in a bucket.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import numpy as np
@@ -84,8 +85,111 @@ if HAVE_JAX:
         return res
 
 
+if HAVE_JAX:
+
+    def _wavefront_pallas(size: int, K: int, interpret: bool = False):
+        """Build the single-launch pallas wavefront for a (size, K)
+        bucket: the ENTIRE 2*size-step diagonal sweep runs inside one
+        kernel with the DP band held in VMEM, instead of 2*size XLA
+        while-loop iterations each paying dispatch + HBM round trips
+        for the loop carries. The hot state is three [K, LP] int32
+        bands (current/previous diagonals and the sliding window of b)
+        — a few hundred KB, far under the ~16 MB VMEM budget."""
+        from jax.experimental import pallas as pl
+
+        LP = size + 128  # lanes: holds l = size+1, multiple of 128
+        KMAX = 2 * size + 1
+
+        def kernel(a_ref, b_ref, nrow_ref, nm_ref, out_ref):
+            i_idx = jax.lax.broadcasted_iota(jnp.int32, (K, LP), 1)
+            nrow = nrow_ref[:]                         # [K, 1]
+            nm = nm_ref[:]                             # [K, 1]
+            ai = a_ref[:]                              # [K, LP]
+            def concrete(x):
+                # the loop body produces sublane-concrete layouts; inits
+                # built purely from lane iota are sublane-replicated and
+                # Mosaic rejects the back-edge relayout — blend in a
+                # per-row loaded value (no-op condition) to pin the
+                # concrete layout at entry
+                return jnp.where(nrow < -(2 ** 30), 0, x)
+
+            d0 = concrete(jnp.where(i_idx == 0, 0, INF))
+            d1 = concrete(jnp.where(i_idx <= 1, 1, INF))
+            # bj at k=2 holds b[k-1-i]: lane0 = b[1], lane1 = b[0]
+            b0 = b_ref[:, 0:1]
+            b1 = b_ref[:, 1:2]
+            bj = concrete(jnp.where(i_idx == 0, b1,
+                                    jnp.where(i_idx == 1, b0, -2)))
+            res = concrete(jnp.where(nm + jnp.zeros_like(i_idx) == 0, 0,
+                                     jnp.where(
+                                         nm + jnp.zeros_like(i_idx) == 1,
+                                         1, INF)))
+
+            def step(k, carry):
+                dm2, dm1, bj, res = carry
+                j_idx = k - i_idx
+                match = ai == bj
+                up = jnp.where(i_idx == 0, INF,
+                               jnp.roll(dm1, 1, axis=1))
+                diag = jnp.where(i_idx == 0, INF,
+                                 jnp.roll(dm2, 1, axis=1))
+                dk = jnp.where(match, diag, jnp.minimum(up, dm1) + 1)
+                dk = jnp.where(i_idx == 0, k, dk)
+                dk = jnp.where(j_idx == 0, i_idx, dk)
+                dk = jnp.where((j_idx < 0) | (i_idx > k), INF,
+                               dk).astype(jnp.int32)
+                sel = (i_idx == nrow) & (k == nm)
+                res = jnp.where(sel, dk, res)
+                # slide the b window: bj'[i] = b[k-i] = bj[i-1]. Lane-dim
+                # dynamic loads must be 128-aligned on TPU, so read the
+                # aligned block holding column k and mask-select the lane.
+                kk = jnp.clip(k, 0, size - 1)
+                start = pl.multiple_of((kk // 128) * 128, 128)
+                block = b_ref[:, pl.ds(start, 128)]          # [K, 128]
+                lane = jax.lax.broadcasted_iota(jnp.int32, (K, 128), 1)
+                newcol = jnp.sum(
+                    jnp.where(lane == kk % 128, block, 0), axis=1,
+                    keepdims=True)
+                bj = jnp.where(i_idx == 0, newcol,
+                               jnp.roll(bj, 1, axis=1))
+                return dm1, dk, bj, res
+
+            _, _, _, res = jax.lax.fori_loop(2, KMAX, step,
+                                             (d0, d1, bj, res))
+            out_ref[:] = res
+
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((K, LP), jnp.int32),
+            interpret=interpret,
+        )
+
+
+    @functools.lru_cache(maxsize=None)
+    def _wavefront_jitted(size: int, K: int, interpret: bool = False):
+        call = _wavefront_pallas(size, K, interpret=interpret)
+
+        def run(pa, pb, nrow, nm):
+            res = call(pa, pb, nrow, nm)
+            return jnp.take_along_axis(res, nrow, axis=1)[:, 0]
+
+        return jax.jit(run)
+
+
+def _use_pallas() -> bool:
+    import os
+    if os.environ.get("JEPSEN_ETCD_TPU_NO_PALLAS"):
+        return False
+    try:
+        import jax
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def edit_distance_batch(canonical, logs: list,
-                        force_device: bool | None = None) -> list[int]:
+                        force_device: bool | None = None,
+                        force_pallas: bool | None = None) -> list[int]:
     """Indel edit distance of each log vs the canonical, in one device
     launch (the watch checker's per-thread divergence measure)."""
     lens = [len(l) for l in logs] + [len(canonical)]
@@ -106,6 +210,25 @@ def edit_distance_batch(canonical, logs: list,
         pa[k, :len(ec)] = ec
         pb[k, :len(el)] = el
         m[k] = len(el)
+    pallas = _use_pallas() if force_pallas is None else force_pallas
+    if pallas:
+        Kp = -(-K // 8) * 8            # sublane-pad the batch
+        LP = size + 128
+        pa_p = np.full((Kp, LP), -1, np.int32)
+        pa_p[:K, 1:size + 1] = pa[:, :size]  # ai[i] = a[i-1] pre-gather
+        pb_p = np.full((Kp, size), -2, np.int32)
+        pb_p[:K] = pb
+        nrow = np.zeros((Kp, 1), np.int32)
+        nrow[:K, 0] = np.minimum(n, size)
+        nm = np.full((Kp, 1), -1, np.int32)
+        nm[:K, 0] = n + m
+        # off-TPU (tests' CPU mesh) the kernel runs in interpret mode,
+        # so the pallas path is exercised everywhere
+        interpret = jax.default_backend() != "tpu"
+        out = _wavefront_jitted(size, Kp, interpret)(
+            jnp.asarray(pa_p), jnp.asarray(pb_p), jnp.asarray(nrow),
+            jnp.asarray(nm))
+        return [int(v) for v in np.asarray(out)[:K]]
     out = _indel_device_batch(jnp.asarray(pa), jnp.asarray(pb),
                               jnp.asarray(n), jnp.asarray(m), size)
     return [int(v) for v in np.asarray(out)]

@@ -243,3 +243,27 @@ def test_edit_distance_batch_matches_single():
     for log, got in zip(logs, batch):
         assert got == _indel_python(canonical, log)
         assert got == edit_distance(canonical, log, force_device=True)
+
+
+def test_edit_distance_pallas_matches_python():
+    """The single-launch pallas wavefront (interpret mode off-TPU) must
+    agree with the Python DP, including empty-log and heavy-divergence
+    edges."""
+    import random
+    from jepsen_etcd_tpu.ops.edit_distance import (
+        edit_distance_batch, _indel_python)
+    rng = random.Random(11)
+    canonical = [rng.randrange(6) for _ in range(150)]
+    logs = [[], list(reversed(canonical)), canonical[:70]]
+    for _ in range(4):
+        log = list(canonical)
+        for _ in range(rng.randrange(0, 15)):
+            if log and rng.random() < 0.5:
+                log.pop(rng.randrange(len(log)))
+            else:
+                log.insert(rng.randrange(len(log) + 1), rng.randrange(6))
+        logs.append(log)
+    got = edit_distance_batch(canonical, logs, force_device=True,
+                              force_pallas=True)
+    want = [_indel_python(canonical, log) for log in logs]
+    assert got == want, (got, want)
