@@ -190,6 +190,31 @@ def check_entries(model, entries, max_configs: int = 5_000_000
             # DFS (the semantic reference) handles it
             return None
 
+    # value-space reductions (ops/common.register_value_sets): merge
+    # dead values into one id; drop info cas with unproducible olds —
+    # the same collapse the kernel pack applies, equally sound here
+    # (the C++ search honors the full semantics, this just shrinks the
+    # reachable state space from 2^I to per-class counts)
+    from ..ops.common import register_value_sets
+    asserted, producible = register_value_sets(
+        (kf, ka1, ka2) for (_e, kf, ka1, ka2, _v) in kept)
+    dead = producible - asserted - {NONE_VAL}
+    if len(dead) > 1:
+        dead_id = min(dead)
+
+        def remap(kf, ka1, ka2):
+            if kf == WRITE and ka1 in dead:
+                return kf, dead_id, ka2
+            if kf == CAS and ka2 in dead:
+                return kf, ka1, dead_id
+            return kf, ka1, ka2
+
+        kept = [(e, *remap(kf, ka1, ka2), kv)
+                for (e, kf, ka1, ka2, kv) in kept]
+    kept = [(e, kf, ka1, ka2, kv) for (e, kf, ka1, ka2, kv) in kept
+            if e.required or not (kf == CAS and ka1 != NONE_VAL
+                                  and ka1 not in producible)]
+
     n = len(kept)
     f = np.array([k[1] for k in kept], dtype=np.int8)
     a1 = np.array([k[2] for k in kept], dtype=np.int32)

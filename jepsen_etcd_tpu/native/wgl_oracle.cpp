@@ -29,6 +29,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <algorithm>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -131,6 +133,23 @@ extern "C" int32_t wgl_oracle_check(
     req_order[j] = v;
   }
 
+  // version ceilings: a required op asserting a version can only fire
+  // while the register version is below/at its ceiling (read: ver,
+  // update: ver-1). Version never decreases, so any state whose
+  // version exceeds the MINIMUM ceiling among unlinearized required
+  // ops is dead — the prune that stops info-heavy searches from
+  // wandering through count combinations no future assertion can
+  // match (the dominant blowup for faulted register histories).
+  std::vector<std::pair<int32_t, int32_t>> ceil_order;  // (ceiling, e)
+  for (int32_t e = 0; e < n; e++) {
+    if (req[e] && ver[e] != NO_ASSERT)
+      ceil_order.emplace_back(f[e] == F_READ ? ver[e] : ver[e] - 1, e);
+  }
+  std::sort(ceil_order.begin(), ceil_order.end());
+  std::vector<size_t> ceil_rank(n, 0);  // entry -> index in ceil_order
+  for (size_t r = 0; r < ceil_order.size(); r++)
+    ceil_rank[ceil_order[r].second] = r;
+
   // enabled-candidate prefix masks: pre[r] = entries with inv < the
   // r-th-by-ret required op's ret. The per-config candidate walk then
   // iterates only (pre[min_ret_op] & ~mask) set bits — O(n/64 + #cand)
@@ -146,14 +165,18 @@ extern "C" int32_t wgl_oracle_check(
       if (inv[e] < bound) row[e >> 6] |= 1ULL << (e & 63);
   }
 
-  // frame layout: [mask: nw][value<<32|version: 1][pmask: nw_req]
-  // pmask mirrors the mask permuted into ret-rank order over required
-  // ops (bits >= Rreq pre-set), so the min-ret scan is a word-wise
-  // first-zero search instead of an O(depth) bit walk. The visited key
-  // is the fw-word prefix only — pmask is a function of the mask.
+  // frame layout:
+  //   [mask: nw][value<<32|version: 1][pmask: nw_req][cmask: nw_ceil]
+  // pmask/cmask mirror the mask permuted into ret-rank / ceiling-rank
+  // order over required ops (bits past the rank count pre-set), so the
+  // min-ret and min-ceiling scans are word-wise first-zero searches
+  // instead of O(depth) bit walks. The visited key is the fw-word
+  // prefix only — both permuted masks are functions of the mask.
   const size_t n_req = req_order.size();
   const size_t nw_req = (n_req + 63) / 64;
-  const size_t fs = fw + nw_req;  // full stack-frame width
+  const size_t n_ceil = ceil_order.size();
+  const size_t nw_ceil = (n_ceil + 63) / 64;
+  const size_t fs = fw + nw_req + nw_ceil;  // full stack-frame width
 
   KeySet visited;
   visited.init(fw, 1 << 16);
@@ -161,6 +184,8 @@ extern "C" int32_t wgl_oracle_check(
   stack.assign(fs, 0);          // initial: empty mask, value 0, version 0
   for (size_t b = n_req; b < nw_req * 64; b++)
     stack[fw + (b >> 6)] |= 1ULL << (b & 63);
+  for (size_t b = n_ceil; b < nw_ceil * 64; b++)
+    stack[fw + nw_req + (b >> 6)] |= 1ULL << (b & 63);
 
   int64_t configs = 0;
   int32_t best_depth = -1, blocked_op = -1;
@@ -197,13 +222,48 @@ extern "C" int32_t wgl_oracle_check(
       return 1;
     }
 
+    int32_t min_ceil = INT32_MAX;
+    int32_t min_ceil_op = -1;
+    const uint64_t* cm = frame.data() + fw + nw_req;
+    for (size_t w = 0; w < nw_ceil; w++) {
+      if (cm[w] != ~0ULL) {
+        const size_t r = (w << 6) + __builtin_ctzll(~cm[w]);
+        min_ceil = ceil_order[r].first;
+        min_ceil_op = ceil_order[r].second;
+        break;
+      }
+    }
+    if (version > min_ceil) {
+      // dead: that op can never fire. Keep the counterexample
+      // diagnostics the candidate walk would have produced.
+      int32_t d = 0;
+      for (size_t ww = 0; ww < nw; ww++)
+        d += __builtin_popcountll(m[ww]);
+      if (d >= best_depth) {
+        best_depth = d;
+        blocked_op = min_ceil_op;
+        blocked_version = version;
+        blocked_value = value;
+      }
+      continue;
+    }
+
+    // Two passes: info candidates pushed first, required last, so the
+    // LIFO pop explores required ops first — greedy progress on the
+    // forced schedule, with crashed ops interleaved only when a
+    // required op is blocked. With id-order pushes an info-heavy
+    // history makes the DFS burrow through 2^I crashed-op subsets
+    // before advancing the schedule at all; witness search on valid
+    // histories goes from budget-exhausting to near-linear.
     const uint64_t* enabled = &pre[r_min * nw];
+    for (int pass = 0; pass < 2; pass++) {
     for (size_t w = 0; w < nw; w++) {
       uint64_t cand = enabled[w] & ~m[w];
       while (cand) {
         const int32_t e =
             static_cast<int32_t>((w << 6) + __builtin_ctzll(cand));
         cand &= cand - 1;
+        if ((pass == 0) == static_cast<bool>(req[e])) continue;
         if (sym_pred[e] >= 0 && !get_bit(m, sym_pred[e])) continue;
         bool ok;
         int32_t nval;
@@ -242,10 +302,15 @@ extern "C" int32_t wgl_oracle_check(
         if (req[e]) {
           const size_t r = rank_of[e];
           child[fw + (r >> 6)] |= 1ULL << (r & 63);
+          if (ver[e] != NO_ASSERT) {
+            const size_t cr = ceil_rank[e];
+            child[fw + nw_req + (cr >> 6)] |= 1ULL << (cr & 63);
+          }
         }
         if (visited.insert(child.data()))
           stack.insert(stack.end(), child.begin(), child.end());
       }
+    }
     }
   }
 

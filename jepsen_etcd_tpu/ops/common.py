@@ -100,6 +100,39 @@ class ValueIds:
         return got
 
 
+def register_value_sets(triples):
+    """Classify register-language value ids over (f, a1, a2) triples
+    (f: 0 read / 1 write / 2 cas; a1: read-expected|write-payload|
+    cas-old; a2: cas-new; WILDCARD = -1 read asserts nothing).
+
+    Returns (asserted, producible):
+    - asserted: ids some step COMPARES against the register state
+      (read expectations, cas olds);
+    - producible: ids some step can MAKE the state (write payloads,
+      cas news), plus 0 (the initial None).
+
+    A producible id that is never asserted is a *dead value*: no guard
+    distinguishes it from any other dead value, so all dead values can
+    merge into one id without changing any verdict (the runs of the
+    original and merged histories are in value-mapping bijection). And
+    a cas whose old id is neither producible nor 0 can never fire.
+    Both reductions collapse the otherwise-exponential space of
+    crashed (:info) updates with distinct never-observed values —
+    the dominant 'unknown' regime for faulted register histories."""
+    asserted = set()
+    producible = {0}
+    for f, a1, a2 in triples:
+        if f == 0:
+            if a1 != -1:
+                asserted.add(a1)
+        elif f == 1:
+            producible.add(a1)
+        else:
+            asserted.add(a1)
+            producible.add(a2)
+    return asserted, producible
+
+
 def as_version(v) -> int:
     """An etcd version assertion as int, faithful to == against int
     model versions; raises UnsupportedValue for anything whose equality

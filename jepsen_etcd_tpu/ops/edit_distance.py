@@ -214,6 +214,12 @@ def edit_distance_batch(canonical, logs: list,
     if pallas:
         Kp = -(-K // 8) * 8            # sublane-pad the batch
         LP = size + 128
+        # the kernel holds ~6 [Kp, LP] int32 bands in VMEM (no grid
+        # tiling over K); past the ~16 MB budget fall back to the XLA
+        # wavefront rather than fail the Mosaic allocation
+        if Kp * LP * 4 * 6 > 12 * 2 ** 20:
+            pallas = False
+    if pallas:
         pa_p = np.full((Kp, LP), -1, np.int32)
         pa_p[:K, 1:size + 1] = pa[:, :size]  # ai[i] = a[i-1] pre-gather
         pb_p = np.full((Kp, size), -2, np.int32)

@@ -127,6 +127,8 @@ class Packed:
     pred_frame: Any = None    # [R, W, NW] uint32
     upd_mask: Any = None      # [R, NW] uint32
     u_forced: Any = None      # [R] int32
+    ceil_frame: Any = None    # [R, W] int32 (version ceiling / CEIL_INF)
+    ceil_beyond: Any = None   # [R] int32 (min ceiling past the window)
     # info tables
     i_f: Any = None           # [I] int8 (WRITE or CAS)
     i_a1: Any = None          # [I] int32 (write val / cas old)
@@ -245,9 +247,6 @@ def _pack_register_history(history, i_max: int, adapter) -> Packed:
             continue
         infos.append((e, npred))
     I = len(infos)
-    if I > min(i_max, I_MAX):
-        return Packed(ok=False, blowup=True,
-                      reason=f"{I} info updates > imask capacity {I_MAX}")
     i_f = np.zeros(I, dtype=np.int8)
     i_a1 = np.zeros(I, dtype=np.int32)
     i_a2 = np.zeros(I, dtype=np.int32)
@@ -258,6 +257,12 @@ def _pack_register_history(history, i_max: int, adapter) -> Packed:
         i_npred[j] = npred
         ef, ev = fv(e)
         val = ev if ev is not None else (None, None)
+        if val[0] is not None:
+            # the kernel's info tables carry no version assertion;
+            # honoring one needs the CPU oracle (real histories never
+            # produce these — invocations haven't learned a version)
+            return Packed(ok=False,
+                          reason="info op with version assertion")
         if ef == "write":
             i_f[j] = WRITE
             i_a1[j] = val_id(val[1])
@@ -269,6 +274,39 @@ def _pack_register_history(history, i_max: int, adapter) -> Packed:
             i_a2[j] = val_id(new)
         else:
             return Packed(ok=False, reason=f"info op f={ef!r} not supported")
+
+    # --- value-space reductions (ops/common.register_value_sets):
+    # merge dead values (producible, never asserted) into one id, and
+    # drop info cas ops whose old value has no producer — they can
+    # never fire. Crashed writes of distinct never-observed values
+    # collapse from 2^I subsets to one symmetry class.
+    from .common import register_value_sets
+    triples = [(int(f[i]), int(a1[i]), int(a2[i])) for i in range(R)] + \
+              [(int(i_f[j]), int(i_a1[j]), int(i_a2[j])) for j in range(I)]
+    asserted, producible = register_value_sets(triples)
+    dead = producible - asserted - {NONE_VAL}
+    if len(dead) > 1:
+        dead_id = min(dead)
+        for i in range(R):
+            if f[i] == WRITE and a1[i] in dead:
+                a1[i] = dead_id
+            elif f[i] == CAS and a2[i] in dead:
+                a2[i] = dead_id
+        for j in range(I):
+            if i_f[j] == WRITE and i_a1[j] in dead:
+                i_a1[j] = dead_id
+            elif i_f[j] == CAS and i_a2[j] in dead:
+                i_a2[j] = dead_id
+    keep = [j for j in range(I)
+            if not (i_f[j] == CAS and i_a1[j] != NONE_VAL
+                    and int(i_a1[j]) not in producible)]
+    if len(keep) < I:
+        i_f, i_a1, i_a2 = i_f[keep], i_a1[keep], i_a2[keep]
+        i_inv, i_npred = i_inv[keep], i_npred[keep]
+        I = len(keep)
+    if I > min(i_max, I_MAX):
+        return Packed(ok=False, blowup=True,
+                      reason=f"{I} info updates > imask capacity {I_MAX}")
     # symmetry reduction: info ops with identical (f, a1, a2) are
     # interchangeable, and a lower-npred member is enabled whenever a
     # higher-npred one is, so any linearization can be rewritten to fire
@@ -331,6 +369,22 @@ def _pack_register_history(history, i_max: int, adapter) -> Packed:
     cum_upd = np.concatenate([[0], np.cumsum(is_upd)])
     u_forced = cum_upd[lo[:R]].astype(np.int32)
 
+    # version ceilings (the native oracle's dead-state prune, on
+    # device): op e with a version assertion can only fire while the
+    # register version is <= its ceiling (read: ver, update: ver-1);
+    # version never decreases, so a state whose version exceeds the
+    # min ceiling among unlinearized required ops is dead. Split into
+    # a per-window-lane table (masked per state) and a static suffix
+    # min for ranks beyond the window.
+    CEIL_INF = np.int32(2 ** 30)
+    ceiling = np.where(ver == NO_ASSERT, CEIL_INF,
+                       np.where(f == READ, ver, ver - 1)).astype(np.int32)
+    ceil_frame = np.where(in_range, ceiling[idx], CEIL_INF)   # [R, W]
+    suffix_min = np.full(R + 1, CEIL_INF, dtype=np.int32)
+    for i in range(R - 1, -1, -1):
+        suffix_min[i] = min(suffix_min[i + 1], ceiling[i])
+    ceil_beyond = suffix_min[np.minimum(lo[:R] + w, R)]       # [R]
+
     # info predecessor tables: info j enabled at depth d iff every
     # required op with ret < inv_j is linearized — ranks < lo[d] are
     # forced; ranks in [lo[d], lo[d]+W) must have their window bit set;
@@ -356,6 +410,7 @@ def _pack_register_history(history, i_max: int, adapter) -> Packed:
         f_code=f[idx].astype(np.int8),
         a1=a1[idx], a2=a2[idx], ver=ver[idx],
         pred_frame=pred_frame, upd_mask=upd_mask, u_forced=u_forced,
+        ceil_frame=ceil_frame, ceil_beyond=ceil_beyond,
         i_f=i_f, i_a1=i_a1, i_a2=i_a2, i_class_pred=i_class_pred,
         i_static_ok=i_static_ok, ipred_frame=ipred_frame,
     )
@@ -407,6 +462,15 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
                + lax.population_count(wvec & rupd)
                .sum(axis=-1).astype(jnp.int32)
                + lax.population_count(ivec).astype(jnp.int32))  # [F]
+    # dead-state prune: version never decreases, so a state whose
+    # version exceeds the min ceiling among unlinearized required ops
+    # (window lanes with clear bits, plus everything past the window)
+    # can never linearize them — drop it from the frontier
+    min_ceil = jnp.minimum(
+        jnp.min(jnp.where(not_set, row(tables["ceil_frame"]),
+                          jnp.int32(2 ** 30)), axis=1),
+        row(tables["ceil_beyond"]))                        # [F]
+    alive = alive & (version <= min_ceil)
     ver_b = version[:, None]
     v = vvec[:, None]                                      # [F, 1]
 
@@ -494,7 +558,10 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
         icp = tables["i_class_pred"][None, :]
         class_ok = (im & icp) == icp
         i_valid = (alive[:, None] & in_i & ibit_clear & istat & ipred_in
-                   & i_model_ok & class_ok)
+                   & i_model_ok & class_ok
+                   # child (version+1, same required set) would be
+                   # ceiling-dead: don't spend a frontier slot on it
+                   & ((version + 1) <= min_ceil)[:, None])
         i_new_i = im | (jnp.uint32(1) << iarange)
         i_new_v = jnp.where(i_is_w, ia1, ia2).astype(jnp.int32)
         i_new_v = jnp.broadcast_to(i_new_v, (f_in, i_pad))
@@ -658,7 +725,13 @@ def pad_tables(p: Packed, r_pad: int, i_pad: int = None):
         "f_code": padded(p.f_code), "a1": padded(p.a1), "a2": padded(p.a2),
         "ver": padded(p.ver), "pred_frame": padded(p.pred_frame),
         "upd_mask": padded(p.upd_mask), "u_forced": padded(p.u_forced),
+        "ceil_frame": padded(p.ceil_frame),
+        "ceil_beyond": padded(p.ceil_beyond),
     }
+    # ceiling padding must be +inf, not 0 (a zero ceiling would prune
+    # clamped-gather rows)
+    t["ceil_frame"][p.ceil_frame.shape[0]:] = 2 ** 30
+    t["ceil_beyond"][p.ceil_beyond.shape[0]:] = 2 ** 30
     if i_pad:
         t.update({
             "i_f": padded_i(p.i_f), "i_a1": padded_i(p.i_a1),

@@ -377,6 +377,147 @@ def test_spill_invalid_verdict_past_fmax():
     assert out.get("spilled"), out
 
 
+def _crashed_writes_history(n_info: int, read=(1, 1)):
+    ops = [
+        Op(type="invoke", process=0, f="write", value=[None, 1]),
+        Op(type="ok", process=0, f="write", value=[1, 1]),
+    ]
+    for j in range(n_info):  # concurrent crashed writes, distinct values
+        ops.append(Op(type="invoke", process=100 + j, f="write",
+                      value=[None, 1000 + j]))
+    ops += [
+        Op(type="invoke", process=1, f="read", value=[None, None]),
+        Op(type="ok", process=1, f="read", value=list(read)),
+    ]
+    for j in range(n_info):
+        ops.append(Op(type="info", process=100 + j, f="write",
+                      value=[None, 1000 + j], error="timeout"))
+    return History(ops)
+
+
+def test_dead_value_merge_collapses_info_classes():
+    """Crashed writes of distinct never-observed values merge into ONE
+    symmetry class: within imask capacity the kernel search collapses
+    to per-class prefix counts, and PAST capacity the (sound) fallback
+    DFS now answers definitively instead of exceeding its budget —
+    2^40 subsets become 41 counts."""
+    # within capacity: kernel packs, classes merged
+    h24 = _crashed_writes_history(24)
+    p = wgl.pack_register_history(h24)
+    assert p.ok and p.I == 24, (p.ok, p.reason, p.I)
+    # all 24 merged: every op's class_pred chains to the previous one
+    assert sum(int(m).bit_count() for m in p.i_class_pred) == \
+        24 * 23 // 2
+    out = TPULinearizableChecker(fallback=False).check({}, h24)
+    assert out["valid?"] is True and out["checker"] == "tpu-wgl", out
+    # past capacity: pack refuses (bits are per-op), but the class
+    # collapse makes the DFS trivial -> definitive via cpu-oracle
+    h40 = _crashed_writes_history(40)
+    p40 = wgl.pack_register_history(h40)
+    assert not p40.ok and p40.blowup
+    out = TPULinearizableChecker().check({}, h40)
+    assert out["valid?"] is True, out
+    assert out["checker"] == "cpu-oracle"
+    # a read observing a crashed value keeps it asserted (alive): the
+    # kernel proves the version contradiction (1007's write and the ok
+    # write can't both be version 1). The unreduced Python DFS can
+    # only answer 'unknown' here (2^24 subsets exceed its budget) —
+    # compatible; on a small instance it must agree exactly
+    bad = _crashed_writes_history(24, read=(1, 1007))
+    tpu = TPULinearizableChecker(fallback=False).check({}, bad)
+    assert tpu["valid?"] is False, tpu
+    cpu = check_history(VersionedRegister(), bad, use_native=False)
+    assert cpu["valid?"] in (False, "unknown"), cpu
+    small_bad = _crashed_writes_history(8, read=(1, 1007))
+    cpu = check_history(VersionedRegister(), small_bad, use_native=False)
+    tpu = TPULinearizableChecker(fallback=False).check({}, small_bad)
+    assert tpu["valid?"] == cpu["valid?"] is False, (tpu, cpu)
+
+
+def test_version_ceiling_prune_info_heavy():
+    """A tightly version-asserted required schedule plus 30 concurrent
+    crashed writes (of ASSERTED values — no dead-value merge applies):
+    the ceiling prune kills every state that fires a crashed update
+    the next assertion can't absorb. Without it this search wanders
+    millions of count combinations; with it, thousands at most —
+    the regime behind test-all's faulted-register unknowns."""
+    ops = []
+    for j in range(30):
+        ops.append(Op(type="invoke", process=100 + j, f="write",
+                      value=[None, j % 5]))
+    for i in range(1, 11):
+        ops += [
+            Op(type="invoke", process=0, f="write", value=[None, i % 5]),
+            Op(type="ok", process=0, f="write", value=[i, i % 5]),
+            Op(type="invoke", process=1, f="read", value=[None, None]),
+            Op(type="ok", process=1, f="read", value=[i, i % 5]),
+        ]
+    for j in range(30):
+        ops.append(Op(type="info", process=100 + j, f="write",
+                      value=[None, j % 5], error="timeout"))
+    h = History(ops)
+    nat = check_history(VersionedRegister(), h)
+    assert nat["valid?"] is True, nat
+    assert nat.get("checker-impl") == "native"
+    assert nat["configs"] < 5_000, nat["configs"]
+    tpu = TPULinearizableChecker(fallback=False).check({}, h)
+    assert tpu["valid?"] is True and tpu["checker"] == "tpu-wgl", tpu
+    assert tpu["peak-frontier"] < 64, tpu
+
+
+def test_unproducible_info_cas_dropped():
+    """A crashed cas whose old value nothing can produce can never
+    fire; it must not count against imask capacity or change verdicts."""
+    ops = [
+        Op(type="invoke", process=0, f="write", value=[None, 1]),
+        Op(type="ok", process=0, f="write", value=[1, 1]),
+    ]
+    for j in range(40):
+        ops.append(Op(type="invoke", process=100 + j, f="cas",
+                      value=[None, [5000 + j, 6000 + j]]))
+    ops += [
+        Op(type="invoke", process=1, f="read", value=[None, None]),
+        Op(type="ok", process=1, f="read", value=[1, 1]),
+    ]
+    for j in range(40):
+        ops.append(Op(type="info", process=100 + j, f="cas",
+                      value=[None, [5000 + j, 6000 + j]], error="timeout"))
+    h = History(ops)
+    p = wgl.pack_register_history(h)
+    assert p.ok, p.reason
+    assert p.I == 0, p.I  # all dropped: olds have no producer
+    out = TPULinearizableChecker(fallback=False).check({}, h)
+    assert out["valid?"] is True, out
+
+
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_differential_wide_value_domain(corrupt):
+    """Random info-heavy histories over a LARGE value domain (most
+    values dead) must agree across kernel, native, and Python engines."""
+    from jepsen_etcd_tpu.native import oracle as native_oracle
+    from jepsen_etcd_tpu.checkers.linearizable import history_entries
+    rng = random.Random(2024 + corrupt)
+    checker = TPULinearizableChecker(fallback=False)
+    definitive = 0
+    for trial in range(60):
+        h = gen_history(rng, n_procs=rng.randint(2, 5),
+                        n_ops=rng.randint(8, 28), values=10_000,
+                        corrupt=corrupt, info_rate=0.3)
+        cpu = check_history(VersionedRegister(), h, use_native=False)
+        nat = native_oracle.check_entries(VersionedRegister(),
+                                          history_entries(h))
+        tpu = checker.check({}, h)
+        assert nat is not None
+        if "unknown" in (tpu["valid?"], cpu["valid?"], nat["valid?"]):
+            continue
+        definitive += 1
+        assert tpu["valid?"] == cpu["valid?"] == nat["valid?"], (
+            f"trial {trial}: kernel={tpu['valid?']} "
+            f"python={cpu['valid?']} native={nat['valid?']}\n"
+            + h.to_jsonl())
+    assert definitive >= 45, f"only {definitive}/60 definitive"
+
+
 def test_spill_resumes_from_frozen_frontier():
     """check_packed(spill=False) hands back the frozen frontier; spilling
     from it must reach the same verdicts as the integrated spill, without
