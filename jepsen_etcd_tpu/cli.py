@@ -66,6 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--store", default="store")
     srv.add_argument("-p", "--port", type=int, default=8080)
     srv.add_argument("-b", "--bind", default="127.0.0.1")
+    gw = sub.add_parser("gateway",
+                        help="serve an etcd v3 JSON-gateway endpoint "
+                             "backed by the simulated MVCC store (the "
+                             "real-etcd adapter's hermetic test double)")
+    gw.add_argument("-p", "--port", type=int, default=2379)
     return p
 
 
@@ -134,6 +139,17 @@ def main(argv=None) -> int:
     if args.command == "serve":
         from .serve import serve_store
         return serve_store(args.store, args.port, args.bind)
+    if args.command == "gateway":
+        from .sut.http_gateway import serve as gw_serve
+        srv, _state = gw_serve(args.port)
+        logging.getLogger("jepsen_etcd_tpu").info(
+            "etcd v3 gateway on http://127.0.0.1:%d (sim store)",
+            srv.server_address[1])
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        return 0
     # kernel-running commands only: initializes the jax backend
     from .ops.common import enable_compile_cache
     enable_compile_cache()

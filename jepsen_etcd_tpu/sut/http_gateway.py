@@ -1,0 +1,320 @@
+"""etcd v3 gRPC-JSON gateway server over the simulated MVCC store.
+
+Two jobs:
+- the hermetic test double for the real-etcd client adapter
+  (client/etcd_http.py): the adapter speaks the same bytes to this
+  server as to a live etcd, so its wire encoding (base64 keys/values,
+  compare targets, txn branches, chunked watch streams) is exercised
+  end-to-end without an etcd binary;
+- a live etcd-wire KV endpoint backed by the simulated MVCC store
+  (`python -m jepsen_etcd_tpu gateway`) — real etcd tooling can talk
+  to the simulated store interactively.
+
+Single-node semantics only (one Store, total order via a lock): the
+fault surface of the real adapter is the real cluster's, not this
+gateway's.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from .store import Store, Txn
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode("ascii")
+
+
+def _unkey(s: str) -> str:
+    return base64.b64decode(s).decode("utf-8")
+
+
+def _unval(s: str) -> Any:
+    raw = base64.b64decode(s)
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return raw.decode("utf-8", "replace")
+
+
+_TARGET_FIELD = {"VALUE": ("value", "value"),
+                 "VERSION": ("version", "version"),
+                 "MOD": ("mod_revision", "mod_revision"),
+                 "CREATE": ("create_revision", "create_revision")}
+_RESULT_OP = {"EQUAL": "=", "LESS": "<", "GREATER": ">"}
+
+
+class GatewayState:
+    def __init__(self):
+        self.store = Store()
+        self.lock = threading.Lock()
+        self.leases: dict[int, int] = {}  # id -> ttl seconds
+        self.next_lease = 0x1000
+
+    def kv_wire(self, kv: dict) -> dict:
+        return {
+            "key": _b64(kv["key"].encode("utf-8")),
+            "value": _b64(json.dumps(kv["value"]).encode("utf-8")),
+            "version": str(kv["version"]),
+            "create_revision": str(kv["create-revision"]),
+            "mod_revision": str(kv["mod-revision"]),
+            "lease": str(kv.get("lease", 0)),
+        }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: GatewayState = None  # set by serve()
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _json(self, obj: dict, code: int = 200) -> None:
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, grpc_code: int, msg: str) -> None:
+        self._json({"error": msg, "code": grpc_code, "message": msg},
+                   code=code)
+
+    def do_POST(self):  # noqa: N802 (stdlib naming)
+        n = int(self.headers.get("Content-Length", 0))
+        try:
+            body = json.loads(self.rfile.read(n) or b"{}")
+        except ValueError:
+            return self._error(400, 3, "invalid json")
+        st = self.state
+        path = self.path
+        try:
+            if path == "/v3/kv/range":
+                with st.lock:
+                    kv = st.store.get(_unkey(body["key"]))
+                    rev = st.store.revision
+                return self._json({
+                    "header": {"revision": str(rev)},
+                    "kvs": [st.kv_wire(kv)] if kv else [],
+                    "count": "1" if kv else "0"})
+            if path == "/v3/kv/txn":
+                return self._txn(body)
+            if path == "/v3/kv/compaction":
+                with st.lock:
+                    rev = int(body.get("revision", 0))
+                    if rev <= st.store.compact_revision:
+                        return self._error(
+                            400, 11,
+                            "etcdserver: mvcc: required revision has "
+                            "been compacted")
+                    st.store.compact(rev)
+                    return self._json(
+                        {"header": {"revision": str(st.store.revision)}})
+            if path == "/v3/lease/grant":
+                with st.lock:
+                    st.next_lease += 1
+                    lid = st.next_lease
+                    st.leases[lid] = int(body.get("TTL", 1))
+                return self._json({"ID": str(lid),
+                                   "TTL": str(st.leases[lid])})
+            if path == "/v3/lease/revoke":
+                return self._lease_revoke(int(body["ID"]))
+            if path == "/v3/lease/keepalive":
+                lid = int(body["ID"])
+                with st.lock:
+                    ttl = st.leases.get(lid, 0)
+                return self._json({"result": {"ID": str(lid),
+                                              "TTL": str(ttl)}})
+            if path == "/v3/lock/lock":
+                return self._lock(body)
+            if path == "/v3/lock/unlock":
+                return self._unlock(body)
+            if path == "/v3/cluster/member/list":
+                return self._json({"members": [{
+                    "ID": "1", "name": "gw0",
+                    "peerURLs": ["http://localhost:0"],
+                    "clientURLs": [f"http://{self.headers.get('Host')}"],
+                }]})
+            if path == "/v3/maintenance/status":
+                with st.lock:
+                    rev = st.store.revision
+                return self._json({
+                    "header": {"revision": str(rev)},
+                    "leader": "1", "raftTerm": "2", "raftIndex": str(rev),
+                    "version": "3.5.6-sim-gateway", "dbSize": "0"})
+            if path == "/v3/maintenance/defragment":
+                return self._json({"header": {}})
+            if path == "/v3/watch":
+                return self._watch(body)
+            return self._error(404, 12, f"unknown path {path}")
+        except KeyError as e:
+            return self._error(400, 3, f"missing field {e}")
+        except (ValueError, TypeError) as e:
+            return self._error(400, 3, f"malformed request: {e}")
+        except Exception as e:  # store-side errors (e.g. compaction)
+            msg = str(e)
+            code = 11 if "compact" in msg.lower() else 13
+            return self._error(400, code, msg)
+
+    # -- kv txn --------------------------------------------------------------
+
+    def _txn(self, body: dict) -> None:
+        st = self.state
+        cmps = []
+        for c in body.get("compare", []):
+            tgt = c.get("target", "VALUE")
+            field, store_target = _TARGET_FIELD[tgt]
+            operand = c.get(field)
+            if tgt == "VALUE":
+                operand = _unval(operand) if operand is not None else None
+            else:
+                operand = int(operand or 0)
+            cmps.append((_RESULT_OP[c.get("result", "EQUAL")],
+                         _unkey(c["key"]), store_target, operand))
+
+        def branch(ops):
+            out = []
+            for o in ops:
+                if "request_range" in o:
+                    out.append(("get", _unkey(o["request_range"]["key"])))
+                elif "request_put" in o:
+                    p = o["request_put"]
+                    out.append(("put", _unkey(p["key"]),
+                                _unval(p["value"]),
+                                int(p.get("lease", 0))))
+                elif "request_delete_range" in o:
+                    out.append(("delete",
+                                _unkey(o["request_delete_range"]["key"])))
+            return out
+
+        txn = Txn(tuple(cmps), tuple(branch(body.get("success", []))),
+                  tuple(branch(body.get("failure", []))))
+        with st.lock:
+            raw = st.store.apply_txn(txn)
+        responses = []
+        for r in raw["results"]:
+            if r[0] == "get":
+                responses.append({"response_range": {
+                    "kvs": [st.kv_wire(r[1])] if r[1] else [],
+                    "count": "1" if r[1] else "0"}})
+            elif r[0] == "put":
+                responses.append({"response_put": (
+                    {"prev_kv": st.kv_wire(r[1])} if r[1] else {})})
+            else:
+                responses.append({"response_delete_range":
+                                  {"deleted": str(r[1])}})
+        self._json({"header": {"revision": str(raw["revision"])},
+                    "succeeded": raw["succeeded"],
+                    "responses": responses})
+
+    # -- leases / locks ------------------------------------------------------
+
+    def _lease_revoke(self, lid: int) -> None:
+        st = self.state
+        with st.lock:
+            if lid not in st.leases:
+                return self._error(
+                    400, 5, "etcdserver: requested lease not found")
+            del st.leases[lid]
+            for key in sorted(st.store.lease_keys.get(lid, ())):
+                st.store.apply_txn(Txn((), (("delete", key),), ()))
+        self._json({"header": {}})
+
+    def _lock(self, body: dict) -> None:
+        st = self.state
+        name = _unkey(body["name"])
+        lid = int(body.get("lease", 0))
+        my_key = f"{name}/{lid:016x}"
+        deadline = time.monotonic() + 30
+        while True:
+            with st.lock:
+                if lid not in st.leases:
+                    return self._error(
+                        400, 5, "etcdserver: requested lease not found")
+                holders = st.store.range_prefix(name + "/")
+                if not holders or all(h["key"] == my_key
+                                      for h in holders):
+                    st.store.apply_txn(
+                        Txn((), (("put", my_key, lid, lid),), ()))
+                    return self._json({
+                        "key": _b64(my_key.encode("utf-8")),
+                        "header": {"revision": str(st.store.revision)}})
+            if time.monotonic() > deadline:
+                return self._error(400, 4, "lock wait deadline")
+            time.sleep(0.01)
+
+    def _unlock(self, body: dict) -> None:
+        st = self.state
+        key = _unkey(body["key"])
+        with st.lock:
+            st.store.apply_txn(Txn((), (("delete", key),), ()))
+        self._json({"header": {}})
+
+    # -- watch (chunked stream) ----------------------------------------------
+
+    def _watch(self, body: dict) -> None:
+        st = self.state
+        start = int(body.get("create_request", {})
+                    .get("start_revision", 0))
+        key = _unkey(body["create_request"]["key"])
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(obj: dict) -> None:
+            data = (json.dumps(obj) + "\n").encode("utf-8")
+            self.wfile.write(f"{len(data):x}\r\n".encode())
+            self.wfile.write(data + b"\r\n")
+            self.wfile.flush()
+
+        chunk({"result": {"created": True, "header": {}}})
+        last = max(0, start - 1)
+        deadline = time.monotonic() + 300
+        try:
+            while time.monotonic() < deadline:
+                with st.lock:
+                    try:
+                        events = [e for e in
+                                  st.store.events_since(last + 1)
+                                  if e.key == key and e.revision > last]
+                    except Exception:
+                        return  # compacted past the watch: close stream
+                    rev = st.store.revision
+                if events:
+                    last = max(e.revision for e in events)
+                    chunk({"result": {
+                        "header": {"revision": str(rev)},
+                        "events": [{
+                            "type": ("DELETE" if e.type == "delete"
+                                     else "PUT"),
+                            **({"kv": st.kv_wire(e.kv)} if e.kv else
+                               {"kv": {
+                                   "key": _b64(e.key.encode()),
+                                   "mod_revision": str(e.revision)}}),
+                            **({"prev_kv": st.kv_wire(e.prev_kv)}
+                               if e.prev_kv else {}),
+                        } for e in events]}})
+                time.sleep(0.02)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+def serve(port: int = 0) -> tuple[ThreadingHTTPServer, GatewayState]:
+    """Start the gateway on localhost:port (0 = ephemeral); returns
+    (server, state). Caller runs server.serve_forever() in a thread and
+    shutdown()s it when done."""
+    state = GatewayState()
+    handler = type("Handler", (_Handler,), {"state": state})
+    srv = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    # watch handlers poll between events; never block server_close (or
+    # interpreter exit) on them
+    srv.daemon_threads = True
+    srv.block_on_close = False
+    return srv, state
