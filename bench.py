@@ -137,6 +137,37 @@ def bench_deep_wgl():
             "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
 
 
+def bench_faulted_register():
+    """Register under kill+partition faults: histories carry :info
+    (crashed) ops — the regime the info-op packing, symmetry classes,
+    and version-ceiling prune exist for. Times the full independent-key
+    checker pass and reports how many keys stayed on the TPU path."""
+    from jepsen_etcd_tpu.workloads.register import workload as reg_wl
+    test, out = run_workload("register", time_limit=40, rate=200,
+                             nemesis=["kill", "partition"],
+                             nemesis_interval=5.0)
+    h = out["history"]
+    infos = len([o for o in h.client_ops() if o.is_info])
+    checker = reg_wl({"nodes": test["nodes"]})["checker"]
+    checker.check(test, h)  # warmup compiles
+    t0 = time.time()
+    res = checker.check(test, h)
+    dt = time.time() - t0
+    keys = res.get("results", {})
+    engines = {}
+    for r in keys.values():
+        for sub in r.values() if isinstance(r, dict) else []:
+            if isinstance(sub, dict) and "checker" in sub:
+                engines[sub["checker"]] = engines.get(
+                    sub["checker"], 0) + 1
+    note(f"faulted register: valid?={res['valid?']} infos={infos} "
+         f"engines={engines} in {dt:.3f}s")
+    assert res["valid?"] is True, res
+    return {"value": round(dt, 4), "unit": "s", "history_ops": len(h),
+            "info_ops": infos, "engines": engines,
+            "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
+
+
 def bench_set():
     """Config #3: set workload — CAS-retry adds + set-full analysis."""
     from jepsen_etcd_tpu.checkers.set_full import SetFull
@@ -195,6 +226,7 @@ def main() -> int:
     matrix = {}
     for name, fn in [("register_100", bench_register_100),
                      ("deep_wgl_4n_2000", bench_deep_wgl),
+                     ("faulted_register", bench_faulted_register),
                      ("set_full", bench_set),
                      ("elle_append_device", bench_elle_append),
                      ("watch_edit_distance", bench_watch)]:
