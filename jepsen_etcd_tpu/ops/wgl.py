@@ -9,25 +9,29 @@ over a sliding window of at most W undecided ops (W auto-selects 32,
 64, or 128 — one, two, or four uint32 words — per history). A search
 state packs to
 
-    (depth d, window mask words, uint32 info mask, model value id)
+    (depth d, window mask words, info class-count words, model value id)
 
-and a BFS wave is a dense [F, W + I] tensor expansion:
+and a BFS wave is a dense [F, W + C] tensor expansion:
 - required candidates: window bit clear ∧ precomputed predecessor-mask
   bits set, model step table-driven (version is *derived*: forced-prefix
-  update count + popcount of update bits in the window + popcount of the
-  info mask — no per-state version storage), window slide by
+  update count + popcount of update bits in the window + the sum of the
+  info counts — no per-state version storage), window slide by
   (lo[d+1]-lo[d]) with shifted-out-bits-must-be-set pruning;
 - info (indefinite) candidates: a crashed/timed-out update may linearize
   at any point after all :ok ops that returned before its invoke, or
-  never (Knossos semantics, checkers/linearizable.py). Each kept info op
-  owns one bit of the info mask; linearizing it keeps d, sets its bit,
-  bumps the derived version, and moves the value. Info *reads* and info
-  ops invoked after the last required return are dropped up front — they
-  can never influence a required op's verdict.
-- dedup = 4-key lax.sort + neighbor-compare + scatter compaction. Every
-  successor's (d + popcount(info mask)) is exactly one greater than its
-  parent's, so waves are strict BFS levels and no state recurs across
-  waves — dedup within a wave is complete dedup.
+  never (Knossos semantics, checkers/linearizable.py). Interchangeable
+  crashed ops (identical effect after dead-value merging) form symmetry
+  classes fired in canonical order, so the reachable info states are
+  per-class prefix COUNTS — each class owns a fixed bit field in the
+  count words, and capacity is the bit budget (NI_MAX words), not one
+  bit per op. Firing a class's next member keeps d, increments its
+  count, bumps the derived version, and moves the value. Info *reads*
+  and info ops invoked after the last required return are dropped up
+  front — they can never influence a required op's verdict.
+- dedup = multi-key lax.sort + neighbor-compare + scatter compaction.
+  Every successor's (d + total info count) is exactly one greater than
+  its parent's, so waves are strict BFS levels and no state recurs
+  across waves — dedup within a wave is complete dedup.
 
 The wave loop is a lax.while_loop; all shapes are static (F_MAX x (W+I)),
 so one compile serves all histories of a bucketed length. On frontier
@@ -55,11 +59,13 @@ W_MAX = 128     # widest window the kernel packs (4 uint32 words).
                 # acquires) spanning many completions, or 8n+
                 # concurrency — push the undecided window past 32;
                 # width auto-selects 32/64/128 per history.
-I_MAX = 32      # info-op capacity (one uint32 mask word)
+NI_MAX = 4      # count words per state (bit budget for info
+                # class fields; 128 bits)
+I_TABLE_MAX = 256  # member-table width cap ([R, I, NW] memory)
 F_MAX = 512     # frontier capacity per wave (in-kernel mode)
 F_MAX_BIG = 4096  # top of the in-kernel retry ladder; past this the
                 # host-driven spill BFS takes over
-# per-wave cost is dominated by the dedup sort of F*(w+i_pad)
+# per-wave cost is dominated by the dedup sort of F*(w+classes)
 # candidates, so running above the needed capacity wastes time
 # proportionally. The ladder ascends geometrically and the search
 # settles at the smallest rung that fits its peak frontier (profiled
@@ -129,13 +135,25 @@ class Packed:
     u_forced: Any = None      # [R] int32
     ceil_frame: Any = None    # [R, W] int32 (version ceiling / CEIL_INF)
     ceil_beyond: Any = None   # [R] int32 (min ceiling past the window)
-    # info tables
-    i_f: Any = None           # [I] int8 (WRITE or CAS)
-    i_a1: Any = None          # [I] int32 (write val / cas old)
-    i_a2: Any = None          # [I] int32 (cas new)
-    i_class_pred: Any = None  # [I] uint32 (same-class ops that must fire 1st)
-    i_static_ok: Any = None   # [R, I] bool (all preds within forced+window)
-    ipred_frame: Any = None   # [R, I, NW] uint32 (window bits that must be set)
+    # info tables. Canonical-order symmetry means the reachable info
+    # states are exactly per-class prefix-count vectors, so the kernel
+    # stores counts packed into NI uint32 words (each class owns a
+    # fixed bit field, never straddling a word) instead of a
+    # one-bit-per-op mask — crashed ops pack while the count bits fit
+    # the budget (NI <= 4 words) and members fit the per-depth tables
+    # (I <= I_TABLE_MAX).
+    C: int = 0                # number of info symmetry classes
+    ni: int = 0               # count words per state
+    c_f: Any = None           # [C] int8 (WRITE or CAS)
+    c_a1: Any = None          # [C] int32 (write val / cas old)
+    c_a2: Any = None          # [C] int32 (cas new)
+    c_size: Any = None        # [C] int32 (members per class)
+    c_off: Any = None         # [C] int32 (first member index, class-major)
+    c_word: Any = None        # [C] int32 (count word index)
+    c_shift: Any = None       # [C] int32 (bit offset within the word)
+    c_mask: Any = None        # [C] uint32 (count field mask)
+    i_static_ok: Any = None   # [R, I] bool, class-major member order
+    ipred_frame: Any = None   # [R, I, NW] uint32, class-major member order
 
 
 MUTEX_LOCKED = "locked"
@@ -153,29 +171,26 @@ def mutex_adapter(f: str, value):
     return None
 
 
-def pack_mutex_history(history, i_max: int = I_MAX) -> Packed:
+def pack_mutex_history(history) -> Packed:
     """Pack a mutex (acquire/release) history for the kernel."""
-    return pack_register_history(history, i_max=i_max,
-                                 adapter=mutex_adapter)
+    return pack_register_history(history, adapter=mutex_adapter)
 
 
-def pack_register_history(history, i_max: int = I_MAX,
-                          adapter=None) -> Packed:
+def pack_register_history(history, adapter=None) -> Packed:
     """Build the per-depth tables for the kernel. Returns ok=False with a
     reason when the history needs the CPU path. ``adapter`` (optional)
     maps each entry's (f, value) into register-language (f, value) —
     models expressible as CAS registers (e.g. Mutex) reuse the whole
     kernel this way."""
     try:
-        return _pack_register_history(history, i_max=i_max,
-                                      adapter=adapter)
+        return _pack_register_history(history, adapter=adapter)
     except UnsupportedValue as e:
         # a value/version whose == semantics the dense id encoding can't
         # carry: sound fallback to the Python oracle
         return Packed(ok=False, reason=f"unsupported value: {e}")
 
 
-def _pack_register_history(history, i_max: int, adapter) -> Packed:
+def _pack_register_history(history, adapter) -> Packed:
     entries = history_entries(history)
     if adapter is not None:
         adapted = {}
@@ -304,25 +319,55 @@ def _pack_register_history(history, i_max: int, adapter) -> Packed:
         i_f, i_a1, i_a2 = i_f[keep], i_a1[keep], i_a2[keep]
         i_inv, i_npred = i_inv[keep], i_npred[keep]
         I = len(keep)
-    if I > min(i_max, I_MAX):
-        return Packed(ok=False, blowup=True,
-                      reason=f"{I} info updates > imask capacity {I_MAX}")
-    # symmetry reduction: info ops with identical (f, a1, a2) are
-    # interchangeable, and a lower-npred member is enabled whenever a
-    # higher-npred one is, so any linearization can be rewritten to fire
-    # each class in (npred, invoke) order. Restricting the search to that
-    # canonical order collapses 2^I info subsets to per-class prefix
-    # counts without losing any verdict.
-    i_class_pred = np.zeros(I, dtype=np.uint32)
+    # symmetry classes: info ops with identical (f, a1, a2) are
+    # interchangeable, and a lower-(npred, invoke) member is enabled
+    # whenever a higher one is, so any linearization can be rewritten
+    # to fire each class in canonical order. The reachable info states
+    # are therefore exactly per-class prefix COUNTS — the kernel packs
+    # them into fixed bit fields (never straddling a word), so capacity
+    # is the bit budget (NI_MAX words), not one bit per op.
+    order = sorted(range(I), key=lambda j: ((int(i_f[j]), int(i_a1[j]),
+                                             int(i_a2[j])),
+                                            (int(i_npred[j]),
+                                             int(i_inv[j]), j)))
+    i_f, i_a1, i_a2 = i_f[order], i_a1[order], i_a2[order]
+    i_inv, i_npred = i_inv[order], i_npred[order]
+    class_runs: list = []  # (start, size)
     for j in range(I):
-        m = np.uint32(0)
-        for k in range(I):
-            if k == j or (i_f[k], i_a1[k], i_a2[k]) != \
-                    (i_f[j], i_a1[j], i_a2[j]):
-                continue
-            if (i_npred[k], i_inv[k], k) < (i_npred[j], i_inv[j], j):
-                m |= np.uint32(1) << np.uint32(k)
-        i_class_pred[j] = m
+        key_j = (int(i_f[j]), int(i_a1[j]), int(i_a2[j]))
+        if class_runs and class_runs[-1][0] == key_j:
+            class_runs[-1][2] += 1
+        else:
+            class_runs.append([key_j, j, 1])
+    C = len(class_runs)
+    c_f = np.array([k[0] for k, _, _ in class_runs], dtype=np.int8)
+    c_a1 = np.array([k[1] for k, _, _ in class_runs], dtype=np.int32)
+    c_a2 = np.array([k[2] for k, _, _ in class_runs], dtype=np.int32)
+    c_off = np.array([off for _, off, _ in class_runs], dtype=np.int32)
+    c_size = np.array([sz for _, _, sz in class_runs], dtype=np.int32)
+    # bit layout: each class's count field is ceil(log2(size+1)) bits,
+    # placed in the first word with room (fields never cross words)
+    c_word = np.zeros(C, dtype=np.int32)
+    c_shift = np.zeros(C, dtype=np.int32)
+    c_mask = np.zeros(C, dtype=np.uint32)
+    word, used = 0, 0
+    for ci in range(C):
+        bits = max(1, int(c_size[ci]).bit_length())
+        if used + bits > 32:
+            word, used = word + 1, 0
+        c_word[ci] = word
+        c_shift[ci] = used
+        c_mask[ci] = (1 << bits) - 1
+        used += bits
+    ni = (word + 1) if C else 0
+    if ni > NI_MAX:
+        return Packed(ok=False, blowup=True,
+                      reason=f"{I} info updates in {C} classes need "
+                             f"{ni} count words > {NI_MAX}")
+    if I > I_TABLE_MAX:
+        return Packed(ok=False, blowup=True,
+                      reason=f"{I} info updates > member-table cap "
+                             f"{I_TABLE_MAX}")
 
     pred = np.searchsorted(sorted_ret, inv, side="left")  # ret[j] < inv[i]
     cap = np.searchsorted(inv, ret, side="left") - 1      # inv[j] < ret[i], j != i
@@ -395,10 +440,10 @@ def _pack_register_history(history, i_max: int, adapter) -> Packed:
         ipred_frame = pack_bits(
             np.swapaxes(pred_in_win, 1, 2), nw)               # [R, I, NW]
         pf = (ret[:, None] < i_inv[None, :])                  # [R, I]
-        C = np.concatenate([np.zeros((1, I), dtype=np.int64),
-                            np.cumsum(pf, axis=0)])           # [R+1, I]
+        cum_pf = np.concatenate([np.zeros((1, I), dtype=np.int64),
+                                 np.cumsum(pf, axis=0)])      # [R+1, I]
         hi = np.minimum(lo[:R] + w, R)                        # [R]
-        i_static_ok = C[hi] == C[R][None, :]                  # [R, I]
+        i_static_ok = cum_pf[hi] == cum_pf[R][None, :]        # [R, I]
     else:
         ipred_frame = np.zeros((R, 0, nw), dtype=np.uint32)
         i_static_ok = np.zeros((R, 0), dtype=bool)
@@ -411,7 +456,8 @@ def _pack_register_history(history, i_max: int, adapter) -> Packed:
         a1=a1[idx], a2=a2[idx], ver=ver[idx],
         pred_frame=pred_frame, upd_mask=upd_mask, u_forced=u_forced,
         ceil_frame=ceil_frame, ceil_beyond=ceil_beyond,
-        i_f=i_f, i_a1=i_a1, i_a2=i_a2, i_class_pred=i_class_pred,
+        C=C, ni=ni, c_f=c_f, c_a1=c_a1, c_a2=c_a2, c_size=c_size,
+        c_off=c_off, c_word=c_word, c_shift=c_shift, c_mask=c_mask,
         i_static_ok=i_static_ok, ipred_frame=ipred_frame,
     )
 
@@ -421,7 +467,7 @@ def _pack_register_history(history, i_max: int, adapter) -> Packed:
 
 
 def _expand(dvec, wvec, ivec, vvec, tables, R, I,
-            w: int, i_pad: int, f_out: int):
+            w: int, f_out: int):
     """One BFS wave: expand a frontier into its deduped successor set.
 
     Pure jax; works standalone (spill mode) and inside the while_loop.
@@ -458,10 +504,22 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
     wm = wvec[:, None, :]                                  # [F, 1, NW]
     not_set = ~jnp.any((wm & B[None]) != 0, axis=-1)       # [F, W]
     preds_in = jnp.all((wm & rpred) == rpred, axis=-1)     # [F, W]
+    # per-class info counts, unpacked from the [F, NI] count words
+    # (classes own fixed bit fields; padding classes have mask 0)
+    ni = ivec.shape[1]
+    c_pad = tables["c_size"].shape[-1] if ni else 0
+    if c_pad:
+        cw = jnp.clip(tables["c_word"], 0, ni - 1)          # [C]
+        ivw = jnp.take(ivec, cw, axis=1)                    # [F, C]
+        counts = (ivw >> tables["c_shift"].astype(jnp.uint32)[None, :]) \
+            & tables["c_mask"][None, :]                     # [F, C]
+        info_total = counts.sum(axis=1).astype(jnp.int32)   # [F]
+    else:
+        info_total = jnp.int32(0)
     version = (ruf
                + lax.population_count(wvec & rupd)
                .sum(axis=-1).astype(jnp.int32)
-               + lax.population_count(ivec).astype(jnp.int32))  # [F]
+               + info_total)                                # [F]
     # dead-state prune: version never decreases, so a state whose
     # version exceeds the min ceiling among unlinearized required ops
     # (window lanes with clear bits, plus everything past the window)
@@ -531,7 +589,7 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
     shifted = rshift_words([new_w[:, :, wi] for wi in range(nw)], s_amt)
     new_w = jnp.stack(shifted, axis=-1)                    # [F, W, NW]
     req_d = jnp.broadcast_to(dvec[:, None] + 1, (f_in, w))
-    req_i = jnp.broadcast_to(ivec[:, None], (f_in, w))
+    req_i = jnp.broadcast_to(ivec[:, None, :], (f_in, w, ni))
     req_v = jnp.where(is_read, v,
                       jnp.where(is_write, ra1, ra2)).astype(jnp.int32)
     accepted = jnp.any(req_valid & (req_d == R))
@@ -542,52 +600,64 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
     cand_i = [req_i]
     cand_v = [jnp.where(req_valid, req_v, SENTINEL_V)]
 
-    if i_pad:
-        iarange = jnp.arange(i_pad, dtype=jnp.uint32)[None, :]  # [1, I]
-        in_i = iarange < jnp.uint32(I)
-        im = ivec[:, None]
-        ibit_clear = ((im >> iarange) & 1) == 0
-        istat = row(tables["i_static_ok"])                 # [F, I]
-        ipredf = row(tables["ipred_frame"])                # [F, I, NW]
-        ipred_in = jnp.all((wm & ipredf) == ipredf, axis=-1)
-        ifc = tables["i_f"][None, :]
-        ia1 = tables["i_a1"][None, :]
-        ia2 = tables["i_a2"][None, :]
-        i_is_w = ifc == WRITE
-        i_model_ok = i_is_w | ((ifc == CAS) & (ia1 == v))
-        icp = tables["i_class_pred"][None, :]
-        class_ok = (im & icp) == icp
-        i_valid = (alive[:, None] & in_i & ibit_clear & istat & ipred_in
-                   & i_model_ok & class_ok
+    if c_pad:
+        # class candidates: fire each class's NEXT member (the
+        # count-th in canonical order); the count field increments in
+        # place (fields never overflow: can_more gates at class size)
+        can_more = counts < tables["c_size"][None, :].astype(jnp.uint32)
+        i_tab = tables["i_static_ok"].shape[-1]
+        member = jnp.clip(tables["c_off"][None, :]
+                          + counts.astype(jnp.int32), 0, i_tab - 1)
+        # single advanced-index gather straight to [F, C(, NW)]: a
+        # row() gather first would materialize the full [F, i_tab, NW]
+        # slab (i_tab up to 256) every wave
+        istat = tables["i_static_ok"][d_cl[:, None], member]  # [F, C]
+        ipredf = tables["ipred_frame"][d_cl[:, None], member]
+        ipred_in = jnp.all((wm & ipredf) == ipredf, axis=-1)  # [F, C]
+        cfc = tables["c_f"][None, :]
+        ca1 = tables["c_a1"][None, :]
+        ca2 = tables["c_a2"][None, :]
+        c_is_w = cfc == WRITE
+        i_model_ok = c_is_w | ((cfc == CAS) & (ca1 == v))
+        i_valid = (alive[:, None] & can_more & istat & ipred_in
+                   & i_model_ok
                    # child (version+1, same required set) would be
                    # ceiling-dead: don't spend a frontier slot on it
                    & ((version + 1) <= min_ceil)[:, None])
-        i_new_i = im | (jnp.uint32(1) << iarange)
-        i_new_v = jnp.where(i_is_w, ia1, ia2).astype(jnp.int32)
-        i_new_v = jnp.broadcast_to(i_new_v, (f_in, i_pad))
+        i_new_i = ivec[:, None, :] + tables["c_inc"][None, :, :]
+        i_new_v = jnp.broadcast_to(
+            jnp.where(c_is_w, ca1, ca2).astype(jnp.int32),
+            (f_in, c_pad))
         cand_d.append(jnp.where(i_valid, jnp.broadcast_to(
-            dvec[:, None], (f_in, i_pad)), SENTINEL_D))
+            dvec[:, None], (f_in, c_pad)), SENTINEL_D))
         cand_w.append(jnp.where(
             i_valid[:, :, None],
-            jnp.broadcast_to(wvec[:, None, :], (f_in, i_pad, nw)),
+            jnp.broadcast_to(wvec[:, None, :], (f_in, c_pad, nw)),
             jnp.uint32(SENTINEL_W)))
-        cand_i.append(i_new_i)
+        cand_i.append(jnp.where(i_valid[:, :, None], i_new_i,
+                                jnp.broadcast_to(ivec[:, None, :],
+                                                 (f_in, c_pad, ni))))
         cand_v.append(jnp.where(i_valid, i_new_v, SENTINEL_V))
 
     flat_d = jnp.concatenate(cand_d, axis=1).reshape(-1)
     flat_w = jnp.concatenate(cand_w, axis=1).reshape(-1, nw)
-    flat_i = jnp.concatenate(cand_i, axis=1).reshape(-1)
+    flat_i = (jnp.concatenate(cand_i, axis=1).reshape(-1, ni) if ni
+              else jnp.zeros((flat_d.shape[0], 0), dtype=jnp.uint32))
     flat_v = jnp.concatenate(cand_v, axis=1).reshape(-1)
 
-    ops = (flat_d, *[flat_w[:, wi] for wi in range(nw)], flat_i, flat_v)
+    ops = (flat_d, *[flat_w[:, wi] for wi in range(nw)],
+           *[flat_i[:, iw] for iw in range(ni)], flat_v)
     sorted_ = lax.sort(ops, num_keys=len(ops))
     sd = sorted_[0]
     sw = list(sorted_[1:1 + nw])
-    si, sv = sorted_[1 + nw], sorted_[2 + nw]
+    si = list(sorted_[1 + nw:1 + nw + ni])
+    sv = sorted_[1 + nw + ni]
     is_real = sd != SENTINEL_D
-    change = (sd[1:] != sd[:-1]) | (si[1:] != si[:-1]) | (sv[1:] != sv[:-1])
+    change = (sd[1:] != sd[:-1]) | (sv[1:] != sv[:-1])
     for wi in range(nw):
         change = change | (sw[wi][1:] != sw[wi][:-1])
+    for iw in range(ni):
+        change = change | (si[iw][1:] != si[iw][:-1])
     first = jnp.concatenate([jnp.array([True]), change])
     uniq = is_real & first
     pos = jnp.cumsum(uniq.astype(jnp.int32)) - 1
@@ -595,33 +665,35 @@ def _expand(dvec, wvec, ivec, vvec, tables, R, I,
     pos = jnp.where(uniq & (pos < f_out), pos, f_out)      # drop overflowed
     out_d = jnp.full((f_out + 1,), SENTINEL_D, dtype=jnp.int32)
     out_w = jnp.full((f_out + 1, nw), SENTINEL_W, dtype=jnp.uint32)
-    out_i = jnp.full((f_out + 1,), jnp.uint32(0), dtype=jnp.uint32)
+    out_i = jnp.zeros((f_out + 1, ni), dtype=jnp.uint32)
     out_v = jnp.full((f_out + 1,), SENTINEL_V, dtype=jnp.int32)
     out_d = out_d.at[pos].set(sd, mode="drop")[:f_out]
     out_w = out_w.at[pos].set(jnp.stack(sw, axis=-1), mode="drop")[:f_out]
-    out_i = out_i.at[pos].set(si, mode="drop")[:f_out]
+    if ni:
+        out_i = out_i.at[pos].set(jnp.stack(si, axis=-1),
+                                  mode="drop")
+    out_i = out_i[:f_out]
     out_v = out_v.at[pos].set(sv, mode="drop")[:f_out]
     return out_d, out_w, out_i, out_v, n_new, accepted
 
 
 @functools.lru_cache(maxsize=None)
-def _kernel_resume_jitted(f_max: int, w: int, i_pad: int):
+def _kernel_resume_jitted(f_max: int, w: int):
     """The ONE jitted wave-loop form per rung. Fresh searches seed the
     initial frontier on the host and enter through the same resume
-    signature, so each (f_max, w, i_pad) shape compiles exactly once —
+    signature, so each (f_max, w) rung compiles once per table shape —
     wide-window (W=128) compiles are expensive enough that a separate
     fresh-start compile per rung would double a multi-minute bill."""
     import jax
 
     def run(tables, R, I, k0, d0, w0, i0, v0, n0):
-        return _wgl_loop(tables, R, I, f_max, w, i_pad,
+        return _wgl_loop(tables, R, I, f_max, w,
                          (k0, d0, w0, i0, v0, n0))
 
     return jax.jit(run)
 
 
-def _wgl_kernel(tables: dict, R, I, f_max: int = F_MAX, w: int = W,
-                i_pad: int = 0):
+def _wgl_kernel(tables: dict, R, I, f_max: int = F_MAX, w: int = W):
     """Run the wave loop from the initial state. tables hold the
     [R_pad, ...] arrays; R (number of required ops) and I (number of
     info ops) are dynamic. Returns (valid, overflow, waves_done,
@@ -630,10 +702,10 @@ def _wgl_kernel(tables: dict, R, I, f_max: int = F_MAX, w: int = W,
     the host driver RESUMES from it at a higher capacity (the retry
     ladder) or in spill mode, without redoing earlier waves.
     """
-    return _wgl_loop(tables, R, I, f_max, w, i_pad, None)
+    return _wgl_loop(tables, R, I, f_max, w, None)
 
 
-def _wgl_loop(tables: dict, R, I, f_max: int, w: int, i_pad: int, init0):
+def _wgl_loop(tables: dict, R, I, f_max: int, w: int, init0):
     import jax.numpy as jnp
     from jax import lax
 
@@ -643,7 +715,7 @@ def _wgl_loop(tables: dict, R, I, f_max: int, w: int, i_pad: int, init0):
         # elements finish; finished elements must be no-ops.
         active = (~accepted) & (n_alive > 0) & (~overflow) & (k < R + I + 1)
         out_d, out_w, out_i, out_v, n_new, acc_now = _expand(
-            dvec, wvec, ivec, vvec, tables, R, I, w, i_pad, f_max)
+            dvec, wvec, ivec, vvec, tables, R, I, w, f_max)
         ovf_now = (n_new > f_max) & (~acc_now)
         # on overflow, freeze the pre-expansion frontier for spill resume
         advance = active & (~ovf_now)
@@ -662,12 +734,13 @@ def _wgl_loop(tables: dict, R, I, f_max: int, w: int, i_pad: int, init0):
         return (~accepted) & (n_alive > 0) & (~overflow) & (k < R + I + 1)
 
     nw = w // 32
+    ni = tables["c_inc"].shape[-1] if "c_inc" in tables else 0
     if init0 is None:
         d0 = jnp.full((f_max,), SENTINEL_D, dtype=jnp.int32)
         d0 = d0.at[0].set(0)
         w0 = jnp.full((f_max, nw), SENTINEL_W, dtype=jnp.uint32)
         w0 = w0.at[0].set(0)
-        i0 = jnp.zeros((f_max,), dtype=jnp.uint32)
+        i0 = jnp.zeros((f_max, ni), dtype=jnp.uint32)
         v0 = jnp.full((f_max,), SENTINEL_V, dtype=jnp.int32)
         v0 = v0.at[0].set(NONE_VAL)
         k0, n0, peak0 = jnp.int32(0), jnp.int32(1), jnp.int32(1)
@@ -689,34 +762,43 @@ def bucket(n: int) -> int:
     return b
 
 
-def bucket_i(n: int) -> int:
-    """Info-op bucket: 0 keeps clean histories on the info-free compile."""
-    if n == 0:
-        return 0
-    b = 8
-    while b < n:
-        b *= 2
-    return min(b, I_MAX)
+def info_dims(p: Packed) -> tuple[int, int, int]:
+    """Bucketed (c_pad, ni_pad, i_tab) so jit caches stay warm: padded
+    class count, count words, and member-table width. All zero for
+    info-free histories (keeps them on the info-free compile)."""
+    if p.C == 0:
+        return 0, 0, 0
+    c_pad = 8
+    while c_pad < p.C:
+        c_pad *= 2
+    ni_pad = 1
+    while ni_pad < p.ni:
+        ni_pad *= 2
+    i_tab = 8
+    while i_tab < p.I:
+        i_tab *= 2
+    return c_pad, ni_pad, i_tab
 
 
-def pad_tables(p: Packed, r_pad: int, i_pad: int = None):
+def pad_tables(p: Packed, r_pad: int, info: tuple = None):
     """Pad the per-depth tables to bucketed lengths (shared by
     check_packed and the __graft_entry__ paths)."""
-    if i_pad is None:
-        i_pad = bucket_i(p.I)
+    if info is None:
+        info = info_dims(p)
+    c_pad, ni_pad, i_tab = info
 
     def padded(a, rows=r_pad):
         out = np.zeros((rows,) + a.shape[1:], dtype=a.dtype)
         out[:a.shape[0]] = a
         return out
 
-    def padded_i(a):
-        out = np.zeros((i_pad,), dtype=a.dtype)
-        out[:p.I] = a
+    def padded_c(a):
+        out = np.zeros((c_pad,), dtype=a.dtype)
+        out[:p.C] = a
         return out
 
     def padded_ri(a):
-        out = np.zeros((r_pad, i_pad) + a.shape[2:], dtype=a.dtype)
+        out = np.zeros((r_pad, i_tab) + a.shape[2:], dtype=a.dtype)
         out[:a.shape[0], :p.I] = a
         return out
 
@@ -732,11 +814,16 @@ def pad_tables(p: Packed, r_pad: int, i_pad: int = None):
     # clamped-gather rows)
     t["ceil_frame"][p.ceil_frame.shape[0]:] = 2 ** 30
     t["ceil_beyond"][p.ceil_beyond.shape[0]:] = 2 ** 30
-    if i_pad:
+    if c_pad:
+        inc = np.zeros((c_pad, ni_pad), dtype=np.uint32)
+        inc[np.arange(p.C), p.c_word] = \
+            np.uint32(1) << p.c_shift.astype(np.uint32)
         t.update({
-            "i_f": padded_i(p.i_f), "i_a1": padded_i(p.i_a1),
-            "i_a2": padded_i(p.i_a2),
-            "i_class_pred": padded_i(p.i_class_pred),
+            "c_f": padded_c(p.c_f), "c_a1": padded_c(p.c_a1),
+            "c_a2": padded_c(p.c_a2), "c_size": padded_c(p.c_size),
+            "c_off": padded_c(p.c_off), "c_word": padded_c(p.c_word),
+            "c_shift": padded_c(p.c_shift), "c_mask": padded_c(p.c_mask),
+            "c_inc": inc,
             "i_static_ok": padded_ri(p.i_static_ok),
             "ipred_frame": padded_ri(p.ipred_frame),
         })
@@ -744,11 +831,11 @@ def pad_tables(p: Packed, r_pad: int, i_pad: int = None):
 
 
 @functools.lru_cache(maxsize=None)
-def _expand_jitted(f_in: int, w: int, i_pad: int, f_out: int):
+def _expand_jitted(f_in: int, w: int, f_out: int):
     import jax
 
     def run(dvec, wvec, ivec, vvec, tables, R, I):
-        return _expand(dvec, wvec, ivec, vvec, tables, R, I, w, i_pad, f_out)
+        return _expand(dvec, wvec, ivec, vvec, tables, R, I, w, f_out)
 
     return jax.jit(run)
 
@@ -773,7 +860,7 @@ def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
 
     The frontier lives on host as numpy arrays; each wave expands it in
     SPILL_CHUNK-sized chunks through the single-wave expand kernel at
-    full output capacity (SPILL_CHUNK * (W + i_pad) slots can hold every
+    full output capacity (SPILL_CHUNK * (W + classes) slots can hold every
     possible successor of a chunk, so nothing is dropped), then merges
     across chunks with np.unique. Sound *and* complete: the only exit
     without a verdict is the explicit state budget.
@@ -784,28 +871,28 @@ def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
     """
     import jax.numpy as jnp
 
-    i_pad = bucket_i(p.I)
+    c_pad, ni, _i_tab = info_dims(p)
     nw = p.w // 32
     # W=128: a full-size chunk would make the lossless-output sort
     # (f_in * 129 slots) prohibitively slow to compile; spill there is
     # a last resort behind the DFS anyway
     f_in = SPILL_CHUNK if p.w < W_MAX else 1024
-    f_out = f_in * (p.w + max(i_pad, 1))
-    expand = _expand_jitted(f_in, p.w, i_pad, f_out)
+    f_out = f_in * (p.w + max(c_pad, 1))
+    expand = _expand_jitted(f_in, p.w, f_out)
     dvec, wvec, ivec, vvec, n_alive = [np.asarray(x) for x in frontier]
     n = int(n_alive)
     fr = np.concatenate(
         [dvec[:n, None].astype(np.int64),
          wvec[:n].astype(np.int64).reshape(n, nw),
-         ivec[:n, None].astype(np.int64),
-         vvec[:n, None].astype(np.int64)], axis=1)  # [n, 3 + nw]
+         ivec[:n].astype(np.int64).reshape(n, ni),
+         vvec[:n, None].astype(np.int64)], axis=1)  # [n, 2 + nw + ni]
     import time as _time
     # compile warmup outside the wall budget: an all-sentinel chunk is
     # a no-op wave, but it forces the (expensive, possibly minutes for
     # W=128) expand compile so the budget measures search, not XLA
     expand(jnp.full((f_in,), SENTINEL_D, dtype=jnp.int32),
            jnp.full((f_in, nw), SENTINEL_W, dtype=jnp.uint32),
-           jnp.zeros((f_in,), dtype=jnp.uint32),
+           jnp.zeros((f_in, ni), dtype=jnp.uint32),
            jnp.full((f_in,), SENTINEL_V, dtype=jnp.int32),
            tables, jnp.int32(p.R), jnp.int32(p.I))
     t_start = _time.monotonic()
@@ -825,12 +912,12 @@ def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
             cn = chunk.shape[0]
             cd = np.full(f_in, SENTINEL_D, dtype=np.int32)
             cw = np.full((f_in, nw), SENTINEL_W, dtype=np.uint32)
-            ci = np.zeros(f_in, dtype=np.uint32)
+            ci = np.zeros((f_in, ni), dtype=np.uint32)
             cv = np.full(f_in, SENTINEL_V, dtype=np.int32)
             cd[:cn] = chunk[:, 0]
             cw[:cn] = chunk[:, 1:1 + nw].astype(np.uint32)
-            ci[:cn] = chunk[:, 1 + nw].astype(np.uint32)
-            cv[:cn] = chunk[:, 2 + nw]
+            ci[:cn] = chunk[:, 1 + nw:1 + nw + ni].astype(np.uint32)
+            cv[:cn] = chunk[:, 1 + nw + ni]
             out_d, out_w, out_i, out_v, n_new, accepted = expand(
                 jnp.asarray(cd), jnp.asarray(cw), jnp.asarray(ci),
                 jnp.asarray(cv), tables, jnp.int32(p.R), jnp.int32(p.I))
@@ -844,10 +931,10 @@ def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
                 succs.append(np.concatenate(
                     [np.asarray(out_d)[:m, None].astype(np.int64),
                      np.asarray(out_w)[:m].astype(np.int64),
-                     np.asarray(out_i)[:m, None].astype(np.int64),
+                     np.asarray(out_i)[:m].astype(np.int64),
                      np.asarray(out_v)[:m, None].astype(np.int64)], axis=1))
         if not succs:
-            fr = np.zeros((0, 3 + nw), dtype=np.int64)
+            fr = np.zeros((0, 2 + nw + ni), dtype=np.int64)
             break
         fr = np.unique(np.concatenate(succs, axis=0), axis=0)
         waves += 1
@@ -875,9 +962,9 @@ def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
 
 
 @functools.lru_cache(maxsize=None)
-def _batched_kernel_jitted(f_max: int, w: int, i_pad: int):
+def _batched_kernel_jitted(f_max: int, w: int):
     import jax
-    kernel = functools.partial(_wgl_kernel, f_max=f_max, w=w, i_pad=i_pad)
+    kernel = functools.partial(_wgl_kernel, f_max=f_max, w=w)
     return jax.jit(jax.vmap(kernel))
 
 
@@ -909,15 +996,15 @@ def check_packed_batch(packs: list, f_max: Optional[int] = None) -> list:
         elif p.R == 0:
             results[i] = {"valid?": True, "waves": 0}
         else:
-            groups.setdefault((bucket(p.R), bucket_i(p.I), p.w),
+            groups.setdefault((bucket(p.R), info_dims(p), p.w),
                               []).append(i)
-    for (r_pad, i_pad, w), idxs in groups.items():
-        _check_bucket_group(packs, results, idxs, r_pad, i_pad, w, f_max)
+    for (r_pad, info, w), idxs in groups.items():
+        _check_bucket_group(packs, results, idxs, r_pad, info, w, f_max)
     return results
 
 
 def _check_bucket_group(packs: list, results: list, idxs: list,
-                        r_pad: int, i_pad: int, w: int,
+                        r_pad: int, info: tuple, w: int,
                         f_max: Optional[int]) -> None:
     """One vmapped launch for a same-bucket key group; results written
     in place."""
@@ -934,7 +1021,7 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
     devs = jax.devices()
     n_dev = len(devs)
     k_pad = -(-K // n_dev) * n_dev  # shard the key axis evenly
-    per_key = [pad_tables(packs[i], r_pad, i_pad) for i in idxs]
+    per_key = [pad_tables(packs[i], r_pad, info) for i in idxs]
     stacked = {}
     for name in per_key[0]:
         arrs = [t[name] for t in per_key]
@@ -959,7 +1046,7 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
         put = jnp.asarray
     tables_dev = {k: put(v) for k, v in stacked.items()}
     valid, overflow, waves, peak, _frontier = _batched_kernel_jitted(
-        f_max, w, i_pad)(tables_dev, put(Rs), put(Is))
+        f_max, w)(tables_dev, put(Rs), put(Is))
     valid = np.asarray(valid)
     overflow = np.asarray(overflow)
     waves = np.asarray(waves)
@@ -1015,9 +1102,9 @@ def check_packed(p: Packed, f_max: Optional[int] = None,
         # the DFS-first overflow path (TPULinearizableChecker._overflow)
         # take it from there
         ladder = [f for f in ladder if f <= F_MAX] or [ladder[0]]
-    i_pad = bucket_i(p.I)
+    _c_pad, ni, _i_tab = info_dims(p)
     tables = {k: jnp.asarray(v)
-              for k, v in pad_tables(p, bucket(p.R), i_pad).items()}
+              for k, v in pad_tables(p, bucket(p.R)).items()}
     R_, I_ = jnp.int32(p.R), jnp.int32(p.I)
     peak_all = 1
     nw = p.w // 32
@@ -1025,14 +1112,14 @@ def check_packed(p: Packed, f_max: Optional[int] = None,
     d0[0] = 0
     w0 = np.full((ladder[0], nw), SENTINEL_W, dtype=np.uint32)
     w0[0] = 0
-    i0 = np.zeros((ladder[0],), dtype=np.uint32)
+    i0 = np.zeros((ladder[0], ni), dtype=np.uint32)
     v0 = np.full((ladder[0],), SENTINEL_V, dtype=np.int32)
     v0[0] = NONE_VAL
     valid, overflow, k, peak, frontier = _kernel_resume_jitted(
-        ladder[0], p.w, i_pad)(tables, R_, I_, jnp.int32(0),
-                               jnp.asarray(d0), jnp.asarray(w0),
-                               jnp.asarray(i0), jnp.asarray(v0),
-                               jnp.int32(1))
+        ladder[0], p.w)(tables, R_, I_, jnp.int32(0),
+                        jnp.asarray(d0), jnp.asarray(w0),
+                        jnp.asarray(i0), jnp.asarray(v0),
+                        jnp.int32(1))
     peak_all = max(peak_all, int(peak))
     for f_next in ladder[1:]:
         if not bool(overflow):
@@ -1046,11 +1133,12 @@ def check_packed(p: Packed, f_max: Optional[int] = None,
         w0 = jnp.concatenate([wvec, jnp.full((grow, wvec.shape[1]),
                                              SENTINEL_W,
                                              dtype=jnp.uint32)])
-        i0 = jnp.concatenate([ivec, jnp.zeros((grow,), dtype=jnp.uint32)])
+        i0 = jnp.concatenate([ivec, jnp.zeros((grow, ivec.shape[1]),
+                                              dtype=jnp.uint32)])
         v0 = jnp.concatenate([vvec, jnp.full((grow,), SENTINEL_V,
                                              dtype=jnp.int32)])
         valid, overflow, k, peak, frontier = _kernel_resume_jitted(
-            f_next, p.w, i_pad)(tables, R_, I_, k, d0, w0, i0, v0, n_alive)
+            f_next, p.w)(tables, R_, I_, k, d0, w0, i0, v0, n_alive)
         peak_all = max(peak_all, int(peak))
     valid = bool(valid)
     if bool(overflow):

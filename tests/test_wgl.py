@@ -401,23 +401,22 @@ def test_dead_value_merge_collapses_info_classes():
     to per-class prefix counts, and PAST capacity the (sound) fallback
     DFS now answers definitively instead of exceeding its budget —
     2^40 subsets become 41 counts."""
-    # within capacity: kernel packs, classes merged
+    # dead-value merge folds all crashed writes into ONE class, so the
+    # info state is a single prefix count — a handful of bits
     h24 = _crashed_writes_history(24)
     p = wgl.pack_register_history(h24)
     assert p.ok and p.I == 24, (p.ok, p.reason, p.I)
-    # all 24 merged: every op's class_pred chains to the previous one
-    assert sum(int(m).bit_count() for m in p.i_class_pred) == \
-        24 * 23 // 2
+    assert p.C == 1 and p.ni == 1, (p.C, p.ni)
     out = TPULinearizableChecker(fallback=False).check({}, h24)
     assert out["valid?"] is True and out["checker"] == "tpu-wgl", out
-    # past capacity: pack refuses (bits are per-op), but the class
-    # collapse makes the DFS trivial -> definitive via cpu-oracle
+    # 40 crashed writes — past the old one-bit-per-op limit (32) —
+    # still pack: counts, not bits
     h40 = _crashed_writes_history(40)
     p40 = wgl.pack_register_history(h40)
-    assert not p40.ok and p40.blowup
-    out = TPULinearizableChecker().check({}, h40)
-    assert out["valid?"] is True, out
-    assert out["checker"] == "cpu-oracle"
+    assert p40.ok and p40.I == 40 and p40.C == 1, \
+        (p40.ok, p40.reason, p40.C)
+    out = TPULinearizableChecker(fallback=False).check({}, h40)
+    assert out["valid?"] is True and out["checker"] == "tpu-wgl", out
     # a read observing a crashed value keeps it asserted (alive): the
     # kernel proves the version contradiction (1007's write and the ok
     # write can't both be version 1). The unreduced Python DFS can
@@ -432,6 +431,39 @@ def test_dead_value_merge_collapses_info_classes():
     cpu = check_history(VersionedRegister(), small_bad, use_native=False)
     tpu = TPULinearizableChecker(fallback=False).check({}, small_bad)
     assert tpu["valid?"] == cpu["valid?"] is False, (tpu, cpu)
+
+
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_differential_high_info(corrupt):
+    """Histories with MANY crashed ops (often I > 32 — past the old
+    one-bit-per-op limit) pack as per-class counts and must agree with
+    the native engine."""
+    from jepsen_etcd_tpu.native import oracle as native_oracle
+    from jepsen_etcd_tpu.checkers.linearizable import history_entries
+    rng = random.Random(909 + corrupt)
+    checker = TPULinearizableChecker(fallback=False)
+    definitive = 0
+    seen_high_i = 0
+    for trial in range(18):
+        h = gen_history(rng, n_procs=rng.randint(4, 8),
+                        n_ops=rng.randint(90, 160), values=3,
+                        corrupt=corrupt, info_rate=0.6)
+        p = wgl.pack_register_history(h)
+        if not p.ok:
+            continue
+        if p.I > 32:
+            seen_high_i += 1
+        nat = native_oracle.check_entries(VersionedRegister(),
+                                          history_entries(h))
+        tpu = checker.check({}, h)
+        if "unknown" in (tpu["valid?"], nat["valid?"]):
+            continue
+        definitive += 1
+        assert tpu["valid?"] == nat["valid?"], (
+            f"trial {trial} (I={p.I}, C={p.C}): kernel={tpu['valid?']} "
+            f"native={nat['valid?']}\n" + h.to_jsonl())
+    assert definitive >= 10, f"only {definitive}/18 definitive"
+    assert seen_high_i >= 2, f"only {seen_high_i} high-I packs"
 
 
 def test_version_ceiling_prune_info_heavy():
