@@ -466,6 +466,45 @@ def test_differential_high_info(corrupt):
     assert seen_high_i >= 2, f"only {seen_high_i} high-I packs"
 
 
+def test_multiword_count_state():
+    """Many DISTINCT classes (crashed cas ops with distinct asserted
+    olds) overflow one count word: the ni=2 layout must agree with the
+    native engine on both verdicts."""
+    from jepsen_etcd_tpu.native import oracle as native_oracle
+    from jepsen_etcd_tpu.checkers.linearizable import history_entries
+    n = 40
+    ops = []
+    for j in range(n):
+        ops.append(Op(type="invoke", process=100 + j, f="cas",
+                      value=[None, [j, 500 + j]]))
+    cur = None
+    ver = 0
+    for j in range(n):  # sequential required writes produce each old
+        ops += [Op(type="invoke", process=0, f="write", value=[None, j]),
+                Op(type="ok", process=0, f="write", value=[ver + 1, j])]
+        ver += 1
+        cur = j
+    ops += [Op(type="invoke", process=1, f="read", value=[None, None]),
+            Op(type="ok", process=1, f="read", value=[ver, cur])]
+    for j in range(n):
+        ops.append(Op(type="info", process=100 + j, f="cas",
+                      value=[None, [j, 500 + j]], error="timeout"))
+    h = History(ops)
+    p = wgl.pack_register_history(h)
+    assert p.ok and p.C == n and p.ni >= 2, (p.ok, p.reason, p.C, p.ni)
+    tpu = TPULinearizableChecker(fallback=False).check({}, h)
+    nat = native_oracle.check_entries(VersionedRegister(),
+                                      history_entries(h))
+    assert tpu["valid?"] == nat["valid?"] is True, (tpu, nat)
+    # and an impossible final read stays jointly invalid
+    bad = History(ops[:-1 - n] + [
+        Op(type="ok", process=1, f="read", value=[ver, 12345])] + ops[-n:])
+    tpu = TPULinearizableChecker(fallback=False).check({}, bad)
+    nat = native_oracle.check_entries(VersionedRegister(),
+                                      history_entries(bad))
+    assert tpu["valid?"] == nat["valid?"] is False, (tpu, nat)
+
+
 def test_version_ceiling_prune_info_heavy():
     """A tightly version-asserted required schedule plus 30 concurrent
     crashed writes (of ASSERTED values — no dead-value merge applies):
