@@ -117,7 +117,9 @@ class Packed:
 
     ok: bool
     reason: str = ""
-    blowup: bool = False  # state space provably astronomical (>=2^32)
+    blowup: bool = False  # structurally past kernel capacity (count
+                          # bits / member tables); DFS gets a trimmed
+                          # budget there
     R: int = 0
     I: int = 0
     n_values: int = 0
