@@ -444,9 +444,9 @@ def test_differential_high_info(corrupt):
     checker = TPULinearizableChecker(fallback=False)
     definitive = 0
     seen_high_i = 0
-    for trial in range(18):
+    for trial in range(12):
         h = gen_history(rng, n_procs=rng.randint(4, 8),
-                        n_ops=rng.randint(90, 160), values=3,
+                        n_ops=rng.randint(80, 130), values=3,
                         corrupt=corrupt, info_rate=0.6)
         p = wgl.pack_register_history(h)
         if not p.ok:
@@ -462,7 +462,7 @@ def test_differential_high_info(corrupt):
         assert tpu["valid?"] == nat["valid?"], (
             f"trial {trial} (I={p.I}, C={p.C}): kernel={tpu['valid?']} "
             f"native={nat['valid?']}\n" + h.to_jsonl())
-    assert definitive >= 10, f"only {definitive}/18 definitive"
+    assert definitive >= 7, f"only {definitive}/12 definitive"
     assert seen_high_i >= 2, f"only {seen_high_i} high-I packs"
 
 
