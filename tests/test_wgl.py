@@ -763,6 +763,39 @@ def test_window_past_64_uses_w128():
     assert bad["valid?"] is False, bad
 
 
+def test_wide_window_with_info_ops():
+    """The W=128 x info-count intersection on one fixed shape: a
+    70-wide window plus crashed writes must agree with the native
+    engine on valid and invalid variants."""
+    from jepsen_etcd_tpu.native import oracle as native_oracle
+    from jepsen_etcd_tpu.checkers.linearizable import history_entries
+    for bad in (False, True):
+        ops = list(_wide_window_history(70))
+        if bad:
+            # a value nothing (required or crashed) ever writes:
+            # unrescuable, unlike a small version skew which the
+            # crashed writes below could legally absorb
+            ops += [Op(type="invoke", process=300, f="read",
+                       value=[None, None]),
+                    Op(type="ok", process=300, f="read",
+                       value=[None, 424242])]
+        for j in range(6):
+            ops.insert(1, Op(type="invoke", process=200 + j, f="write",
+                             value=[None, 900 + j]))
+        for j in range(6):
+            ops.append(Op(type="info", process=200 + j, f="write",
+                          value=[None, 900 + j], error="timeout"))
+        h = History([o.evolve(index=None) for o in ops])
+        p = wgl.pack_register_history(h)
+        assert p.ok and p.w == 128 and p.I == 6, \
+            (p.ok, p.reason, p.w, p.I)
+        tpu = TPULinearizableChecker(fallback=False).check({}, h)
+        nat = native_oracle.check_entries(VersionedRegister(),
+                                          history_entries(h))
+        assert tpu["valid?"] == nat["valid?"] == (not bad), \
+            (bad, tpu, nat["valid?"])
+
+
 def test_window_past_128_rejected():
     p = wgl.pack_register_history(_wide_window_history(140))
     assert not p.ok and "window" in p.reason
