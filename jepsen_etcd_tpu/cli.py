@@ -12,7 +12,7 @@ import logging
 import sys
 
 from .compose import etcd_test, default_opts
-from .workloads import workloads, WORKLOADS_EXPECTED_TO_PASS
+from .workloads import workloads, ALL_WORKLOADS, WORKLOADS_EXPECTED_TO_PASS
 from .runner.test_runner import run_test
 
 # nemesis combinations swept by test-all (etcd.clj:60-73)
@@ -34,7 +34,10 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="command", required=True)
     for cmd in ("test", "test-all"):
         s = sub.add_parser(cmd)
-        s.add_argument("-w", "--workload", default="register",
+        # None means "register" for test, "all workloads" for test-all
+        # (the reference's test-all honors -w as a narrowing filter,
+        # etcd.clj:238-242)
+        s.add_argument("-w", "--workload", default=None,
                        choices=sorted(workloads().keys()))
         s.add_argument("--nemesis", default="",
                        help="comma-separated faults: kill,pause,partition,"
@@ -52,6 +55,16 @@ def build_parser() -> argparse.ArgumentParser:
         s.add_argument("--client-type", default="direct",
                        choices=["direct", "etcdctl"])
         s.add_argument("--snapshot-count", type=int, default=100)
+        s.add_argument("--unsafe-no-fsync", action="store_true",
+                       help="ask the SUT not to fsync WAL appends "
+                            "(etcd.clj:204)")
+        s.add_argument("--corrupt-check", action="store_true",
+                       help="enable the runtime corruption monitor: "
+                            "initial check at boot + a sweep every "
+                            "virtual minute (etcd.clj:164, db.clj:97-99)")
+        s.add_argument("-v", "--version", default="sim-3.5.6",
+                       help="SUT version to run (etcd.clj:206-207; the "
+                            "sim ships exactly one)")
         s.add_argument("--seed", type=int, default=0)
         s.add_argument("--debug", action="store_true")
         s.add_argument("--tcpdump", action="store_true",
@@ -99,7 +112,7 @@ def opts_from_args(args) -> dict:
             conc = int(conc)
     return {
         "nodes": nodes,
-        "workload": args.workload,
+        "workload": args.workload or "register",
         "nemesis": parse_nemesis_spec(args.nemesis),
         "nemesis_interval": args.nemesis_interval,
         "rate": args.rate,
@@ -110,11 +123,29 @@ def opts_from_args(args) -> dict:
         "lazyfs": args.lazyfs,
         "client_type": args.client_type,
         "snapshot_count": args.snapshot_count,
+        "unsafe_no_fsync": args.unsafe_no_fsync,
+        "corrupt_check": args.corrupt_check,
+        "version": args.version,
         "seed": args.seed,
         "debug": args.debug,
         "tcpdump": args.tcpdump,
         "store_base": args.store,
     }
+
+
+def test_all_matrix(args) -> tuple[list, list]:
+    """The test-all sweep axes, narrowed by -w / --nemesis when given
+    (all-tests, etcd.clj:236-242: a single workload or nemesis combo
+    replaces the full axis)."""
+    if args.workload:
+        wls = [args.workload]
+    elif args.only_workloads_expected_to_pass:
+        wls = list(WORKLOADS_EXPECTED_TO_PASS)
+    else:
+        wls = list(ALL_WORKLOADS)
+    nemeses = [parse_nemesis_spec(args.nemesis)] if args.nemesis \
+        else ALL_NEMESES
+    return wls, nemeses
 
 
 def run_one(opts: dict) -> dict:
@@ -163,10 +194,9 @@ def main(argv=None) -> int:
         return 0 if ok else 1
     # test-all: nemeses x workloads sweep (all-tests, etcd.clj:226-244)
     base = opts_from_args(args)
-    wls = WORKLOADS_EXPECTED_TO_PASS if args.only_workloads_expected_to_pass \
-        else sorted(workloads().keys())
+    wls, nemeses = test_all_matrix(args)
     failures = []
-    for nem in ALL_NEMESES:
+    for nem in nemeses:
         for wl in wls:
             for i in range(args.test_count):
                 opts = dict(base)

@@ -37,9 +37,12 @@ def default_opts() -> dict:
         "lazyfs": False,
         "client_type": "direct",        # or "etcdctl" (etcd.clj:161-164)
         "snapshot_count": 100,          # etcd.clj:197-200
+        "unsafe_no_fsync": False,       # etcd.clj:204 (opt-in, like etcd)
+        "corrupt_check": False,         # etcd.clj:164
         "seed": 0,
         "debug": False,
-        "version": "sim-3.5.6",
+        "version": "sim-3.5.6",         # etcd.clj:206-207 (pinned: the sim
+                                        # has exactly one "binary")
     }
 
 

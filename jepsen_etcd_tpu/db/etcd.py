@@ -33,6 +33,7 @@ class Db:
         self.initialized = False          # db.clj:219-220 atom
         self.members: Optional[set] = None  # db.clj:107-112 atom
         self.next_node_id = 0
+        self._corrupt_monitor = None
 
     # ---- DB protocol -------------------------------------------------------
 
@@ -51,8 +52,23 @@ class Db:
             # pin the post-setup state (lazyfs checkpoint!, db.clj:222-223)
             for n in test["nodes"]:
                 cluster.checkpoint_node(n)
+        if test.get("corrupt_check"):
+            # --corrupt-check (db.clj:97-99): initial check at boot, then
+            # a periodic monitor every virtual minute, the
+            # --experimental-corrupt-check-time 1m analog
+            cluster.check_corruption()
+
+            async def monitor():
+                while cluster.running:
+                    await sleep(60 * SECOND)
+                    cluster.check_corruption()
+            self._corrupt_monitor = loop.spawn(monitor(),
+                                               "db-corrupt-monitor")
 
     async def teardown(self, test: dict) -> None:
+        if test.get("corrupt_check"):
+            # final sweep before shutdown freezes node state
+            test["cluster"].check_corruption()
         test["cluster"].shutdown()
 
     def log_files(self, test: dict) -> dict:
@@ -66,6 +82,9 @@ class Db:
         cluster: Cluster = test["cluster"]
         try:
             cluster.start_node(node, fresh=not self.initialized)
+            if test.get("corrupt_check"):
+                # --experimental-initial-corrupt-check: verify at boot
+                cluster.check_corruption()
             return "started"
         except SimError as e:
             if e.type == "corrupt":

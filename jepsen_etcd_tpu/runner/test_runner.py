@@ -93,9 +93,19 @@ def run_test(test: dict) -> dict:
                                test.get("name", "test"))
     test["store_dir"] = store_dir
     try:
+        # thread the reference's SUT knobs from opts into the cluster
+        # (etcd.clj:164,197-204 -> db.clj:88-99); an explicit
+        # cluster_config still wins for tests that build their own
         cluster = Cluster(loop, list(test["nodes"]),
                           test.get("cluster_config") or ClusterConfig(
-                              lazyfs=bool(test.get("lazyfs"))))
+                              lazyfs=bool(test.get("lazyfs")),
+                              snapshot_count=(
+                                  100 if test.get("snapshot_count") is None
+                                  else int(test["snapshot_count"])),
+                              unsafe_no_fsync=bool(
+                                  test.get("unsafe_no_fsync")),
+                              corrupt_check=bool(
+                                  test.get("corrupt_check"))))
         test["cluster"] = cluster
         if test.get("tcpdump"):
             # network-event trace (the --tcpdump analog, db.clj:276-277)
@@ -157,6 +167,14 @@ def run_test(test: dict) -> dict:
     if task_leak is not None:
         results["task-leak"] = {"valid?": False, "error": task_leak}
         results["valid?"] = False
+    if test.get("corrupt_check"):
+        # definite verdict from the runtime corruption monitor
+        # (etcd.clj:164); the fatal alarm log line is independently
+        # caught by the crash-pattern checker
+        alarms = list(cluster.corruption_alarms)
+        results["corrupt-check"] = {"valid?": not alarms, "alarms": alarms}
+        if alarms:
+            results["valid?"] = False
     node_logs = {name: list(node.etcd_log)
                  for name, node in cluster.nodes.items()}
     save_run(store_dir, test, history, results, node_logs)

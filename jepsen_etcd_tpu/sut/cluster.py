@@ -64,9 +64,24 @@ class ClusterConfig:
     repl_delay: tuple = (1 * MS, 5 * MS)  # node->node replication latency
     rpc_delay: tuple = (1 * MS, 3 * MS)   # client->node latency (per leg)
     snapshot_count: int = 100             # reference stress default
-    unsafe_no_fsync: bool = True          # reference passes this flag
+    unsafe_no_fsync: bool = False         # etcd default: fsync on; the
+                                          # reference flips it only when
+                                          # --unsafe-no-fsync is passed
+                                          # (etcd.clj:204, db.clj:96)
     lazyfs: bool = False                  # lose unfsynced writes on kill
+    corrupt_check: bool = False           # record per-node state hashes at
+                                          # fixed applied indexes so the
+                                          # corruption monitor can compare
+                                          # them (etcd.clj:164, db.clj:97-99)
     tick: int = 50 * MS                   # scheduler granularity
+
+
+#: with corrupt_check, fingerprint the applied store at every multiple of
+#: this applied index — all nodes hash at the SAME indexes, the analog of
+#: etcd's hashKV-at-compact-revision peer comparison
+FP_EVERY = 64
+#: bound the per-node fingerprint ledger
+FP_LEDGER_MAX = 256
 
 
 @dataclass
@@ -125,6 +140,10 @@ class Node:
         self.resume_event: Optional[SimEvent] = None
         self.watchers: list = []  # Watcher objects served by this node
         self.store_applied_index = 0
+        # corrupt-check: applied index -> state fingerprint, recorded at
+        # FP_EVERY multiples (deterministic apply means every healthy
+        # node records the same value at the same index)
+        self.fp_ledger: dict[int, int] = {}
 
     # ---- small helpers ----------------------------------------------------
 
@@ -226,6 +245,10 @@ class Node:
             self._apply(e)
             self.store_applied_index = idx
             self.applied_since_snap += 1
+            if self.cluster.cfg.corrupt_check and idx % FP_EVERY == 0:
+                self.fp_ledger[idx] = self.store.state_fingerprint()
+                while len(self.fp_ledger) > FP_LEDGER_MAX:
+                    self.fp_ledger.pop(next(iter(self.fp_ledger)))
         self.maybe_snapshot()
 
     def _apply(self, e: LogEntry) -> None:
@@ -367,6 +390,9 @@ class Cluster:
         self._tick_task = None
         self.next_lease_id = 0x70000000
         self.tracer = None  # runner.trace.NetTrace when --tcpdump is set
+        # corrupt-check monitor state: confirmed divergences + dedupe keys
+        self.corruption_alarms: list[dict] = []
+        self._alarm_keys: set = set()
 
     def _trace(self, kind: str, src: str, dst: str, **info: Any) -> None:
         if self.tracer is not None:
@@ -1030,6 +1056,7 @@ class Cluster:
             n.term = 0
             n.membership = list(initial_membership or self.initial_names)
             n.leases = {}
+            n.fp_ledger = {}
         else:
             self._recover(n)
         n.alive = True
@@ -1046,6 +1073,10 @@ class Cluster:
         n.log_line("etcd server started")
 
     def _recover(self, n: Node) -> None:
+        # ledger restarts with the replay: re-applied entries re-record
+        # the same fingerprints at the same indexes (deterministic apply),
+        # while a silently-damaged snapshot diverges and gets caught
+        n.fp_ledger = {}
         # snapshot
         snap_items, snap_err = walmod.decode_records(n.snap_current)
         if snap_err == "crc-mismatch":
@@ -1168,3 +1199,51 @@ class Cluster:
                          "revision": n.store.revision,
                          "fingerprint": n.store.state_fingerprint()}
         return fps
+
+    def check_corruption(self) -> list[dict]:
+        """The --corrupt-check monitor pass (db.clj:97-99 enables etcd's
+        --experimental-initial-corrupt-check / --corrupt-check-time 1m).
+
+        Applied state at a given raft index is a deterministic function of
+        the log prefix, so two nodes whose hashes differ at the SAME
+        applied index have definitely diverged — the analog of etcd's
+        hashKV peer comparison at a shared revision. Compares both the
+        FP_EVERY-multiple ledgers and the live stores of nodes that
+        happen to sit at equal applied indexes. New divergences are
+        alarm-logged at fatal level on both nodes (so LogFilePattern
+        catches them, like etcd's "found data inconsistency with peers"
+        fatal) and recorded in self.corruption_alarms.
+        """
+        new: list[dict] = []
+        nodes = sorted(self.nodes)
+        live_fp = {a: self.nodes[a].store.state_fingerprint()
+                   for a in nodes}
+        for i, a in enumerate(nodes):
+            na = self.nodes[a]
+            for b in nodes[i + 1:]:
+                nb = self.nodes[b]
+                pairs = [(idx, na.fp_ledger[idx], nb.fp_ledger[idx])
+                         for idx in na.fp_ledger.keys() & nb.fp_ledger.keys()]
+                if (na.store_applied_index == nb.store_applied_index
+                        and na.store_applied_index > 0):
+                    pairs.append((na.store_applied_index,
+                                  live_fp[a], live_fp[b]))
+                for idx, fa, fb in pairs:
+                    if fa == fb:
+                        continue
+                    key = (idx, a, b)
+                    if key in self._alarm_keys:
+                        continue
+                    self._alarm_keys.add(key)
+                    alarm = {"index": idx, "nodes": [a, b],
+                             "fingerprints": [fa, fb],
+                             "time": self.loop.now / SECOND}
+                    new.append(alarm)
+                    for n in (na, nb):
+                        n.etcd_log.append(
+                            f'{{"ts":{self.loop.now / SECOND:.3f},'
+                            f'"level":"fatal","msg":"checkCorrupt: found '
+                            f'data inconsistency with peers","index":{idx},'
+                            f'"peers":["{a}","{b}"]}}')
+        self.corruption_alarms.extend(new)
+        return new

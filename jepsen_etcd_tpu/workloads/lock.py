@@ -4,9 +4,11 @@ Re-design of ``lock.clj``. etcd lock acquisition grants a short lease
 (TTL 2 s, lock.clj:18-20), keeps it alive from a background task, and
 acquires the named lock under that lease (lock.clj:22-56). Because the
 lease is timed at the *leader* and reset on leader change, two clients
-can genuinely hold the "lock" at once under faults — so every workload
-here is expected to FAIL under nemeses (WORKLOADS_EXPECTED_TO_PASS
-excludes the lock family, etcd.clj:47-53).
+can genuinely hold the "lock" at once under faults — so ``lock`` and
+``lock-set`` are expected to FAIL under nemeses. ``lock-etcd-set`` is
+the exception: its txn-level ``version(lock_key) > 0`` guard holds up,
+and the reference expects it to pass (WORKLOADS_EXPECTED_TO_PASS
+removes only :lock and :lock-set, etcd.clj:51-53).
 
 Three clients:
 

@@ -1,0 +1,183 @@
+"""The SUT config plane end-to-end: CLI opts must actually reach the
+cluster (etcd.clj:164,197-204 -> db.clj:88-99), and the --corrupt-check
+monitor must catch silent divergence."""
+
+import pytest
+
+from jepsen_etcd_tpu.cli import build_parser, opts_from_args
+from jepsen_etcd_tpu.cli import test_all_matrix as _test_all_matrix
+from jepsen_etcd_tpu.compose import etcd_test
+from jepsen_etcd_tpu.runner.test_runner import run_test
+from jepsen_etcd_tpu.runner.sim import SimLoop, set_current_loop, SECOND
+from jepsen_etcd_tpu.sut.cluster import Cluster, ClusterConfig, FP_EVERY
+from jepsen_etcd_tpu.checkers import LogFilePattern
+from jepsen_etcd_tpu.workloads import ALL_WORKLOADS, WORKLOADS_EXPECTED_TO_PASS
+
+
+def run(tmp_path, **opts):
+    base = {"time_limit": 6, "rate": 50, "ops_per_key": 30,
+            "store_base": str(tmp_path), "seed": 7}
+    base.update(opts)
+    test = etcd_test(base)
+    out = run_test(test)
+    out["test"] = test
+    return out
+
+
+# ---- snapshot-count / unsafe-no-fsync threading ---------------------------
+
+def test_snapshot_count_reaches_cluster_and_changes_cadence(tmp_path):
+    """--snapshot-count 5 must produce snapshots in a short run where the
+    default 100 produces none on most nodes (etcd.clj:197-200)."""
+    out = run(tmp_path, workload="register", snapshot_count=5)
+    cluster = out["test"]["cluster"]
+    assert cluster.cfg.snapshot_count == 5
+    snaps = [n.snap_index for n in cluster.nodes.values()]
+    assert max(snaps) > 0, "no node ever snapshotted at count=5"
+    saved = [line for node in cluster.nodes.values()
+             for line in node.etcd_log if "saved snapshot" in line]
+    assert saved
+
+
+def test_unsafe_no_fsync_reaches_cluster(tmp_path):
+    out = run(tmp_path, workload="register", unsafe_no_fsync=True)
+    assert out["test"]["cluster"].cfg.unsafe_no_fsync is True
+    # and the default matches etcd's: fsync ON unless the flag is given
+    out2 = run(tmp_path, workload="register")
+    assert out2["test"]["cluster"].cfg.unsafe_no_fsync is False
+
+
+def test_cli_flags_reach_opts():
+    args = build_parser().parse_args(
+        ["test", "--snapshot-count", "7", "--unsafe-no-fsync",
+         "--corrupt-check", "-v", "sim-3.5.6"])
+    opts = opts_from_args(args)
+    assert opts["snapshot_count"] == 7
+    assert opts["unsafe_no_fsync"] is True
+    assert opts["corrupt_check"] is True
+    assert opts["version"] == "sim-3.5.6"
+    # defaults mirror the reference CLI (etcd.clj:157-209)
+    d = opts_from_args(build_parser().parse_args(["test"]))
+    assert d["workload"] == "register"
+    assert d["snapshot_count"] == 100
+    assert d["unsafe_no_fsync"] is False
+    assert d["corrupt_check"] is False
+
+
+# ---- corrupt-check monitor ------------------------------------------------
+
+def _advance(cluster, loop, writes):
+    """Drive enough writes through the leader for FP_EVERY-multiple
+    fingerprints to be recorded on every node."""
+    from jepsen_etcd_tpu.client.direct import DirectClient
+
+    async def go():
+        c = DirectClient(cluster, "n1")
+        await c.await_node_ready()
+        for i in range(writes):
+            await c.put(f"k{i % 8}", f"v{i}")
+    loop.run_coro(go())
+    # let replication/apply drain
+    loop.run_coro(_sleep(2 * SECOND))
+
+
+async def _sleep(dt):
+    from jepsen_etcd_tpu.runner.sim import sleep
+    await sleep(dt)
+
+
+@pytest.fixture
+def corrupt_cluster():
+    loop = SimLoop(seed=3)
+    set_current_loop(loop)
+    cluster = Cluster(loop, ["n1", "n2", "n3"],
+                      ClusterConfig(corrupt_check=True))
+    cluster.launch()
+    yield cluster, loop
+    cluster.shutdown()
+    set_current_loop(None)
+
+
+def test_clean_cluster_no_alarm(corrupt_cluster):
+    cluster, loop = corrupt_cluster
+    _advance(cluster, loop, 2 * FP_EVERY)
+    assert any(n.fp_ledger for n in cluster.nodes.values()), \
+        "fingerprint ledger never recorded"
+    assert cluster.check_corruption() == []
+    assert cluster.corruption_alarms == []
+
+
+def test_bitflipped_but_replayable_node_trips_alarm(corrupt_cluster):
+    """A store that silently diverges (the bitflip-that-passes-CRC case)
+    must raise the corruption alarm with a fatal log line the
+    crash-pattern checker catches."""
+    cluster, loop = corrupt_cluster
+    _advance(cluster, loop, 2 * FP_EVERY)
+    victim = cluster.nodes["n2"]
+    key = sorted(victim.store.kvs)[0]
+    victim.store.kvs[key].value = "corrupted-bits"
+    # poison the ledger too, as a silently-bad replay would
+    for idx in victim.fp_ledger:
+        victim.fp_ledger[idx] ^= 0xDEADBEEF
+    alarms = cluster.check_corruption()
+    assert alarms, "divergence not detected"
+    assert any("n2" in a["nodes"] for a in alarms)
+    # the fatal alarm line matches the crash-pattern regex
+    check = LogFilePattern().check({"cluster": cluster}, [])
+    assert check["valid?"] is False
+    assert any("data inconsistency" in m["line"] for m in check["matches"])
+    # re-checking does not duplicate alarms
+    n = len(cluster.corruption_alarms)
+    cluster.check_corruption()
+    assert len(cluster.corruption_alarms) == n
+
+
+def test_corrupt_check_e2e_clean_run(tmp_path):
+    """--corrupt-check on a healthy run: monitor runs, verdict present
+    and valid."""
+    out = run(tmp_path, workload="register", corrupt_check=True,
+              time_limit=8)
+    assert out["test"]["cluster"].cfg.corrupt_check is True
+    cc = out["results"]["corrupt-check"]
+    assert cc["valid?"] is True and cc["alarms"] == []
+    assert out["valid?"] is True
+    assert any(n.fp_ledger for n in
+               out["test"]["cluster"].nodes.values())
+
+
+# ---- test-all narrowing (etcd.clj:236-242) --------------------------------
+
+def _args(extra):
+    return build_parser().parse_args(["test-all"] + extra)
+
+
+def test_test_all_default_matrix():
+    wls, nems = _test_all_matrix(_args([]))
+    assert wls == ALL_WORKLOADS          # :none excluded (etcd.clj:48-49)
+    assert "none" not in wls
+    assert len(nems) == 8
+    # drift guard: the sweep list must track the registry
+    from jepsen_etcd_tpu.workloads import workloads
+    assert set(ALL_WORKLOADS) == set(workloads()) - {"none"}
+
+
+def test_test_all_workload_narrowing():
+    wls, nems = _test_all_matrix(_args(["-w", "set"]))
+    assert wls == ["set"] and len(nems) == 8
+
+
+def test_test_all_nemesis_narrowing():
+    wls, nems = _test_all_matrix(_args(["--nemesis", "kill,partition"]))
+    assert nems == [["kill", "partition"]]
+    assert wls == ALL_WORKLOADS
+
+
+def test_expected_to_pass_matches_reference():
+    """etcd.clj:51-53 removes only :lock and :lock-set from all-workloads;
+    lock-etcd-set is expected to PASS."""
+    assert "lock-etcd-set" in WORKLOADS_EXPECTED_TO_PASS
+    assert "lock" not in WORKLOADS_EXPECTED_TO_PASS
+    assert "lock-set" not in WORKLOADS_EXPECTED_TO_PASS
+    assert "none" not in WORKLOADS_EXPECTED_TO_PASS
+    assert set(WORKLOADS_EXPECTED_TO_PASS) == \
+        set(ALL_WORKLOADS) - {"lock", "lock-set"}
