@@ -18,13 +18,58 @@ from .linearizable import check_history
 
 logger = logging.getLogger("jepsen_etcd_tpu.checkers")
 
+#: histories at or below this many entries (invoke + completion) route
+#: to the native DFS before any device packing: TPU dispatch costs
+#: ~0.4 s while the native engine answers small searches in single-digit
+#: ms (BENCH_r02 register_100: 0.40 s TPU vs 2.4 ms native — ~166x).
+#: The kernel remains the engine for deep histories and batched keys,
+#: mirroring the CPU_CUTOFF routing in ops/closure.py:37. The split
+#: plays the role of the reference's Knossos-vs-workload division at
+#: register.clj:110-112 (one checker, engine picked by problem size).
+CPU_CUTOFF = 512
+
 
 class TPULinearizableChecker(Checker):
     def __init__(self, model_fn=None, fallback: bool = True,
-                 f_max: Optional[int] = None):
+                 f_max: Optional[int] = None,
+                 cpu_cutoff: Optional[int] = CPU_CUTOFF):
         self.model_fn = model_fn or (lambda: VersionedRegister(0, None))
         self.fallback = fallback
         self.f_max = f_max
+        # fallback=False means "I want the kernel's answer" (the test
+        # harness's way of pinning the TPU path), so the size cutoff
+        # only applies when CPU routing is allowed at all
+        self.cpu_cutoff = cpu_cutoff if fallback else None
+
+    #: cutoff-DFS budget: the "cheap shot" size (same cap _fallback uses
+    #: for blowup histories) — a small history that exhausts this gets
+    #: the kernel's complete BFS instead of more DFS
+    CUTOFF_MAX_CONFIGS = 1_000_000
+
+    def _small_history_check(
+            self, history) -> tuple[Optional[dict], Optional[dict]]:
+        """Size-cutoff routing: below CPU_CUTOFF the native DFS wins by
+        orders of magnitude over device dispatch. Returns (result,
+        unknown): result is the definitive answer or None; unknown
+        carries the budget-exhausted verdict so callers that later fail
+        to reach the kernel can return it instead of re-running the
+        same DFS."""
+        if not self.cpu_cutoff or len(history) > self.cpu_cutoff:
+            return None, None
+        out = check_history(self.model_fn(), history,
+                            max_configs=self.CUTOFF_MAX_CONFIGS)
+        out["checker"] = "cpu-oracle"
+        out["engine-route"] = "size-cutoff"
+        if out["valid?"] == "unknown":
+            return None, out
+        # report the indefinite-entry count like the kernel result does
+        # (wgl.check_packed's "info-ops"): entries the search may decline
+        # to linearize — :info completions AND still-open invokes
+        from .linearizable import history_entries
+        entries = history_entries(history) or []
+        out.setdefault("info-ops",
+                       sum(1 for e in entries if not e.required))
+        return out, None
 
     def _pack_fn(self):
         """The kernel packing for this model, or None for CPU-only
@@ -105,11 +150,23 @@ class TPULinearizableChecker(Checker):
 
     def check(self, test, history, opts=None) -> dict:
         from ..ops import wgl
+        small, small_unknown = self._small_history_check(history)
+        if small is not None:
+            return small
         pack = self._pack_fn()
         if pack is None:
+            if small_unknown is not None:
+                small_unknown["tpu-fallback-reason"] = \
+                    "model has no kernel packing"
+                return small_unknown
             return self._fallback(history, "model has no kernel packing")
         p = pack(history)
         if not p.ok:
+            if small_unknown is not None:
+                # the cutoff DFS already burned the cheap-shot budget;
+                # re-running it here would duplicate that work exactly
+                small_unknown["tpu-fallback-reason"] = p.reason
+                return small_unknown
             return self._fallback(history, p.reason, blowup=p.blowup)
         # with a fallback available, defer the spill BFS until the DFS
         # has had its (cheaper) shot — see _overflow
@@ -122,18 +179,31 @@ class TPULinearizableChecker(Checker):
         kernel launch (the production form of SURVEY §2.3's key-level
         DP axis). Called by checkers.Independent; falls back per key."""
         from ..ops import wgl
-        keys = list(subhistories)
+        results: dict = {}
+        # size-cutoff first: keys whose histories the native DFS answers
+        # in ms never pay packing or dispatch at all
+        big_keys = []
+        for k in subhistories:
+            small, _unknown = self._small_history_check(subhistories[k])
+            if small is not None:
+                results[k] = small
+            else:
+                big_keys.append(k)
+        if not big_keys:
+            return results
         pack = self._pack_fn()
         if pack is None:
-            return {k: self.check(test, subhistories[k], opts)
-                    for k in keys}
-        packs = [pack(subhistories[k]) for k in keys]
+            results.update({k: self.check(test, subhistories[k], opts)
+                            for k in big_keys})
+            return results
+        packs = [pack(subhistories[k]) for k in big_keys]
         outs = wgl.check_packed_batch(packs, f_max=self.f_max)
         # unpackable keys come back "unknown" with the pack reason;
         # _finalize routes those through the CPU fallback (and top-rung
         # overflows through the DFS-then-spill ordering)
-        return {k: self._finalize(subhistories[k], out, pack=p)
-                for (k, out, p) in zip(keys, outs, packs)}
+        results.update({k: self._finalize(subhistories[k], out, pack=p)
+                        for (k, out, p) in zip(big_keys, outs, packs)})
+        return results
 
 
 def tpu_linearizable(model_fn=None) -> TPULinearizableChecker:

@@ -58,7 +58,7 @@ def test_16_keys_single_batched_launch(monkeypatch):
 
     rng = random.Random(41)
     h = multi_key_history(16, rng)
-    out = Independent(TPULinearizableChecker()).check({}, h)
+    out = Independent(TPULinearizableChecker(cpu_cutoff=None)).check({}, h)
     assert out["valid?"] is True
     assert out["key-count"] == 16
     assert calls["batch"] == 1
@@ -75,7 +75,7 @@ def test_batch_matches_per_key_results():
     rng = random.Random(77)
     # find a seedful corrupt key whose per-key verdict is False
     h = multi_key_history(6, rng, corrupt_keys=(2, 4))
-    checker = TPULinearizableChecker()
+    checker = TPULinearizableChecker(cpu_cutoff=None)
     batched = Independent(checker).check({}, h)
     from jepsen_etcd_tpu.generators.independent import history_keys, subhistory
     for k in history_keys(h):
@@ -95,7 +95,7 @@ def test_batch_with_info_ops():
     """Faulted (info-op) histories stay on the batched TPU path."""
     rng = random.Random(5)
     h = multi_key_history(8, rng, info_rate=0.2)
-    out = Independent(TPULinearizableChecker()).check({}, h)
+    out = Independent(TPULinearizableChecker(cpu_cutoff=None)).check({}, h)
     for k, r in out["results"].items():
         assert r["checker"] in ("tpu-wgl",), (k, r)
 
@@ -112,7 +112,7 @@ def test_batch_uneven_sizes_and_empty_key():
                   value=("empty", [None, 3])))
     ops.append(Op(type="info", process=500, f="write",
                   value=("empty", [None, 3]), error="timeout"))
-    out = Independent(TPULinearizableChecker()).check({}, History(ops))
+    out = Independent(TPULinearizableChecker(cpu_cutoff=None)).check({}, History(ops))
     assert out["valid?"] is True
     assert set(out["results"]) == {"small", "big", "empty"}
     assert out["results"]["empty"]["valid?"] is True
@@ -133,7 +133,7 @@ def test_compose_forwards_batch(monkeypatch):
     h = multi_key_history(4, rng)
     from jepsen_etcd_tpu.checkers import Stats
     out = independent_checker(compose({
-        "linear": TPULinearizableChecker(),
+        "linear": TPULinearizableChecker(cpu_cutoff=None),
         "stats": Stats(),
     })).check({}, h)
     assert out["valid?"] is True

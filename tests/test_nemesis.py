@@ -35,11 +35,14 @@ def test_register_under_kill(tmp_path):
     assert out["results"]["workload"]["valid?"] is True, \
         "kill faults must not break linearizability"
     assert {"kill", "start"} & nemesis_fs(out["history"])
-    # faulted histories (info ops from timeouts/kills) must STAY on the
-    # TPU path — the kernel's info-op support, not the CPU oracle
+    # per-key histories here are small, so the size cutoff routes them
+    # to the native DFS; either engine is a sound verdict (the kernel's
+    # info-op support is pinned separately in test_wgl with
+    # fallback=False, which disables the cutoff)
     per_key = out["results"]["workload"]["results"]
     checkers = [r["linear"].get("checker") for r in per_key.values()]
-    assert checkers and all(c == "tpu-wgl" for c in checkers), checkers
+    assert checkers and all(c in ("tpu-wgl", "cpu-oracle")
+                            for c in checkers), checkers
     assert any(r["linear"].get("info-ops", 0) > 0
                for r in per_key.values()), \
         "kill run should produce at least one indefinite op"

@@ -609,7 +609,9 @@ def test_overflow_prefers_dfs_before_spill():
     wide valid history answers fast via cpu-oracle instead of grinding
     through a multi-million-state frontier."""
     h = _concurrent_writes_history(24, read_val=1)  # C(24,12) ~ 2.7M
-    out = TPULinearizableChecker().check({}, h)
+    # cutoff disabled: this pins the kernel-overflow -> DFS ordering,
+    # which only triggers when the history actually reaches the device
+    out = TPULinearizableChecker(cpu_cutoff=None).check({}, h)
     assert out["valid?"] is True, out
     assert out["checker"] == "cpu-oracle", out
     assert "overflow" in out.get("tpu-fallback-reason", ""), out
@@ -855,3 +857,66 @@ def test_differential_wide_histories():
             f"trial {trial} (w={p.w}): kernel={tpu} "
             f"oracle={cpu['valid?']}\n" + h.to_jsonl())
     assert definitive >= 20, f"only {definitive}/30 definitive"
+
+
+# ---- engine-size cutoff (one checker, engine picked by problem size) ------
+
+def test_size_cutoff_routes_small_histories_to_native():
+    """Small histories must answer from the native DFS in milliseconds,
+    never paying device dispatch (BENCH_r02: 0.40 s TPU vs 2.4 ms
+    native on register_100)."""
+    import time
+    rng2 = random.Random(5)
+    h = History([o.evolve(index=None)
+                 for o in gen_history(rng2, n_procs=4, n_ops=100)])
+    assert len(h) <= TPULinearizableChecker().cpu_cutoff
+    checker = TPULinearizableChecker(fallback=True)
+    t0 = time.perf_counter()
+    out = checker.check({}, h)
+    dt = time.perf_counter() - t0
+    assert out["valid?"] is True
+    assert out["checker"] == "cpu-oracle"
+    assert out["engine-route"] == "size-cutoff"
+    assert dt < 0.25, f"cutoff path took {dt:.3f}s"
+
+
+def test_size_cutoff_disabled_when_kernel_pinned():
+    """fallback=False pins the kernel path (the differential harness
+    relies on it), so the cutoff must not apply there."""
+    assert TPULinearizableChecker(fallback=False).cpu_cutoff is None
+
+
+def test_size_cutoff_differential_verdicts():
+    """Cutoff routing must be verdict-preserving: same answers as the
+    kernel on both valid and corrupted histories."""
+    rng2 = random.Random(11)
+    for trial in range(8):
+        h = History([o.evolve(index=None)
+                     for o in gen_history(rng2, n_procs=3, n_ops=24,
+                                          corrupt=(trial % 2 == 1))])
+        via_cutoff = TPULinearizableChecker(fallback=True).check({}, h)
+        via_kernel = TPULinearizableChecker(fallback=False).check({}, h)
+        if via_kernel["valid?"] == "unknown":
+            continue
+        assert via_cutoff["valid?"] == via_kernel["valid?"], (
+            f"trial {trial}: cutoff={via_cutoff['valid?']} "
+            f"kernel={via_kernel['valid?']}")
+
+
+def test_check_batch_splits_small_and_large():
+    """check_batch must answer small keys natively and keep big keys on
+    the batched kernel launch."""
+    rng2 = random.Random(23)
+    small = History([o.evolve(index=None)
+                     for o in gen_history(rng2, n_procs=3, n_ops=20)])
+    big = History([o.evolve(index=None)
+                   for o in gen_history(random.Random(101),
+                                        n_procs=4, n_ops=120)])
+    checker = TPULinearizableChecker(fallback=True, cpu_cutoff=100)
+    assert len(small) <= 100 < len(big)
+    outs = checker.check_batch({}, {"s": small, "b": big})
+    assert outs["s"]["checker"] == "cpu-oracle"
+    assert outs["s"]["engine-route"] == "size-cutoff"
+    assert outs["s"]["valid?"] is True
+    assert outs["b"]["valid?"] is True
+    assert outs["b"]["checker"] == "tpu-wgl"
