@@ -53,7 +53,14 @@ def build_parser() -> argparse.ArgumentParser:
         s.add_argument("--serializable", action="store_true")
         s.add_argument("--lazyfs", action="store_true")
         s.add_argument("--client-type", default="direct",
-                       choices=["direct", "etcdctl"])
+                       choices=["direct", "etcdctl", "http"],
+                       help="direct/etcdctl drive the simulated cluster; "
+                            "http drives a LIVE etcd over its v3 JSON "
+                            "gateway (etcd.clj:246-257)")
+        s.add_argument("--endpoint", default="http://127.0.0.1:2379",
+                       help="comma-separated live-etcd endpoint URLs "
+                            "(only with --client-type http); each "
+                            "endpoint is a node")
         s.add_argument("--snapshot-count", type=int, default=100)
         s.add_argument("--unsafe-no-fsync", action="store_true",
                        help="ask the SUT not to fsync WAL appends "
@@ -103,7 +110,11 @@ def parse_nemesis_spec(spec: str) -> list[str]:
 
 
 def opts_from_args(args) -> dict:
-    nodes = [n.strip() for n in args.nodes.split(",") if n.strip()]
+    if args.client_type == "http":
+        # live mode: nodes ARE the endpoint URLs
+        nodes = [e.strip() for e in args.endpoint.split(",") if e.strip()]
+    else:
+        nodes = [n.strip() for n in args.nodes.split(",") if n.strip()]
     conc = args.concurrency
     if isinstance(conc, str):
         if conc.endswith("n"):

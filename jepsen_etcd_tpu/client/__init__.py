@@ -13,6 +13,11 @@ def client(test, node: str):
     (mirrors the reference constructor dispatch, client.clj:210-222)."""
     ctype = (test.get("client_type") or "direct") if isinstance(test, dict) \
         else "direct"
+    if ctype == "http":
+        # live-etcd mode (etcd.clj:246-257 drives a real cluster): the
+        # node IS its endpoint URL
+        from .etcd_http import HttpEtcdClient
+        return HttpEtcdClient(node)
     cluster = test["cluster"]
     if ctype == "direct":
         return DirectClient(cluster, node)

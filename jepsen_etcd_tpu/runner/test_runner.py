@@ -82,6 +82,8 @@ def check_task_leaks(loop, where: str = "post-run") -> None:
 
 def run_test(test: dict) -> dict:
     """Run a composed test map; returns {valid?, results, history, dir}."""
+    if test.get("client_type") == "http":
+        return run_test_live(test)
     seed = test.get("seed", 0)
     loop = SimLoop(seed=seed)
     set_current_loop(loop)
@@ -161,13 +163,22 @@ def run_test(test: dict) -> dict:
     finally:
         set_current_loop(None)
 
+    return _analyze_and_save(test, history, store_dir, cluster,
+                             task_leak, sim_seconds, t0)
+
+
+def _analyze_and_save(test: dict, history, store_dir: str, cluster,
+                      task_leak, sim_seconds: float, t0: float) -> dict:
+    """Shared run epilogue: checker pass, task-leak / corrupt-check
+    result merge, artifact save, summary line. cluster is None for live
+    runs (no simulated nodes: no node logs, no fingerprints, no trace)."""
     logger.info("Analyzing %d ops (history in %s)", len(history), store_dir)
     results = test["checker"].check(test, history,
                                     {"store_dir": store_dir})
     if task_leak is not None:
         results["task-leak"] = {"valid?": False, "error": task_leak}
         results["valid?"] = False
-    if test.get("corrupt_check"):
+    if test.get("corrupt_check") and cluster is not None:
         # definite verdict from the runtime corruption monitor
         # (etcd.clj:164); the fatal alarm log line is independently
         # caught by the crash-pattern checker
@@ -175,10 +186,10 @@ def run_test(test: dict) -> dict:
         results["corrupt-check"] = {"valid?": not alarms, "alarms": alarms}
         if alarms:
             results["valid?"] = False
-    node_logs = {name: list(node.etcd_log)
-                 for name, node in cluster.nodes.items()}
+    node_logs = {} if cluster is None else {
+        name: list(node.etcd_log) for name, node in cluster.nodes.items()}
     save_run(store_dir, test, history, results, node_logs)
-    if cluster.tracer is not None:
+    if cluster is not None and cluster.tracer is not None:
         import os
         with open(os.path.join(store_dir, "trace.jsonl"), "w") as f:
             f.write(cluster.tracer.to_jsonl())
@@ -188,3 +199,60 @@ def run_test(test: dict) -> dict:
     return {"valid?": results.get("valid?"), "results": results,
             "history": history, "dir": store_dir,
             "sim-seconds": sim_seconds, "wall-seconds": wall}
+
+
+def run_test_live(test: dict) -> dict:
+    """Run a composed test against a LIVE etcd over its JSON gateway
+    (the CLI-drives-a-real-cluster shape of etcd.clj:246-257).
+
+    Same sequence as run_test, on a WallLoop (runner/wall.py): real
+    time, real I/O, no simulated cluster — test['nodes'] are endpoint
+    URLs, the DB layer is the readiness-barrier LiveDb, and faults are
+    rejected upstream (compose) since there is no control plane."""
+    from .wall import WallLoop
+    loop = WallLoop(seed=test.get("seed", 0))
+    set_current_loop(loop)
+    t0 = wall_time.time()
+    store_dir = make_store_dir(test.get("store_base", "store"),
+                               test.get("name", "test"))
+    test["store_dir"] = store_dir
+    test["cluster"] = None  # cluster-reading checkers no-op on None
+    try:
+        db = test["db"]
+        pool = ClientPool(test)
+
+        async def invoke(process: int, op: Op) -> Op:
+            client = pool.client_for(process)
+            return await client.invoke(test, op)
+
+        async def main() -> History:
+            logger.info("Awaiting live cluster %s", test["nodes"])
+            await db.setup(test)
+            await pool.setup_initial(test["concurrency"])
+            logger.info("Running generator (wall clock)")
+            h = await interpret(test, test["generator"], invoke,
+                                test["concurrency"])
+            await pool.teardown()
+            await db.teardown(test)
+            # grace before the leak scan: same TIMEOUT-derived bound as
+            # the sim path, so in-flight rpcs and keepalive pumps
+            # (interval LEASE_TTL/3 < TIMEOUT) can observe closure
+            from .sim import sleep, SECOND
+            from ..client.base import TIMEOUT
+            await sleep(TIMEOUT + 1 * SECOND)
+            return h
+
+        history = loop.run_coro(main())
+        sim_seconds = loop.now / 1e9
+        task_leak = None
+        try:
+            check_task_leaks(loop)
+        except SimError as e:
+            logger.error("task leak detected: %s", e)
+            task_leak = str(e)
+    finally:
+        set_current_loop(None)
+        loop.shutdown()
+
+    return _analyze_and_save(test, history, store_dir, None,
+                             task_leak, sim_seconds, t0)

@@ -145,7 +145,7 @@ class _Handler(BaseHTTPRequestHandler):
                 with st.lock:
                     rev = st.store.revision
                 return self._json({
-                    "header": {"revision": str(rev)},
+                    "header": {"revision": str(rev), "member_id": "1"},
                     "leader": "1", "raftTerm": "2", "raftIndex": str(rev),
                     "version": "3.5.6-sim-gateway", "dbSize": "0"})
             if path == "/v3/maintenance/defragment":
