@@ -9,6 +9,12 @@ Re-design of the reference checker (watch.clj:274-357):
   (watch.clj:304-318);
 - any thread whose log differs (nonzero edit distance, computed by the
   TPU wavefront kernel, ops/edit_distance.py) is a delta -> invalid;
+- threads that recorded a compaction gap (final-watch restarted past the
+  compact horizon, watch.clj:243-267 semantics) are held to a weaker but
+  still sound standard: their log must be an in-order subsequence of
+  canonical and every canonical value they missed must have a revision
+  inside one of their recorded gap windows — omissions are forgiven
+  only where compaction provably destroyed the events;
 - any ``nonmonotonic-watch`` error in history -> invalid
   (watch.clj:320-326, 347-350);
 - if threads' final revisions are unequal the test didn't converge, so
@@ -41,6 +47,27 @@ def per_thread_logs(test, history) -> dict:
             for thread, ops in per_thread_watches(test, history).items()}
 
 
+def per_thread_revs(test, history) -> dict:
+    """Per-thread event-revision logs (parallel to per_thread_logs)."""
+    return {thread: [r for op in ops
+                     for r in ((op.value or {}).get("revs") or [])]
+            for thread, ops in per_thread_watches(test, history).items()}
+
+
+def per_thread_gaps(test, history) -> dict:
+    """Per-thread compaction-gap windows [(from_rev, to_rev], ...]: the
+    unobservable window recorded when a final-watch restarted past the
+    compact horizon (watch.clj:243-267 semantics)."""
+    return {thread: [tuple(g) for op in ops
+                     for g in ((op.value or {}).get("gaps") or [])]
+            for thread, ops in per_thread_watches(test, history).items()}
+
+
+def is_subsequence(sub: list, seq: list) -> bool:
+    it = iter(seq)
+    return all(any(x == y for y in it) for x in sub)
+
+
 def per_thread_revisions(test, history) -> dict:
     return {thread: max([(op.value or {}).get("revision", 0)
                          for op in ops] + [0])
@@ -65,15 +92,52 @@ class WatchChecker(Checker):
     def check(self, test, history, opts=None) -> dict:
         h = history if isinstance(history, History) else History(history)
         logs = per_thread_logs(test, h)
+        revs = per_thread_revs(test, h)
+        gaps = per_thread_gaps(test, h)
         revisions = per_thread_revisions(test, h)
-        canonical = canonical_log(list(logs.values()))
+        full = sorted(t for t in logs if not gaps.get(t))
+        gapped = sorted(t for t in logs if gaps.get(t))
+        # canonical from complete logs when any exist: a gapped log is
+        # legitimately missing its compacted window and must not define
+        # the consensus. With EVERY thread gapped, no single log can
+        # serve (each may be missing values another saw outside its own
+        # window) — merge all observations by server revision instead
+        if full:
+            canonical = canonical_log([logs[t] for t in full])
+        else:
+            by_rev: dict = {}
+            for t in gapped:
+                for v, r in zip(logs[t], revs.get(t, [])):
+                    by_rev.setdefault(r, v)
+            canonical = [v for _, v in sorted(by_rev.items())]
         deltas = []
-        threads = sorted(logs)
-        dists = edit_distance_batch(canonical, [logs[t] for t in threads],
+        dists = edit_distance_batch(canonical, [logs[t] for t in full],
                                     force_device=self.use_tpu)
-        for thread, ed in zip(threads, dists):
+        for thread, ed in zip(full, dists):
             if ed:
                 deltas.append({"thread": thread, "edit-distance": ed,
+                               "diff": diff_report(canonical,
+                                                   logs[thread])})
+        # a gapped thread may omit exactly the values that fell inside a
+        # recorded compaction window — everything it DID see must still
+        # be in canonical order, and every canonical value it missed
+        # must be attributable to a gap
+        value_rev = {}
+        for t in logs:
+            for v, r in zip(logs[t], revs.get(t, [])):
+                value_rev.setdefault(v, r)
+        for thread in gapped:
+            seen = set(logs[thread])
+            missing = [v for v in canonical if v not in seen]
+            unattributed = [
+                v for v in missing
+                if not any(lo < value_rev.get(v, -1) <= hi
+                           for lo, hi in gaps[thread])]
+            if not is_subsequence(logs[thread], canonical) or unattributed:
+                deltas.append({"thread": thread,
+                               "edit-distance": len(unattributed) or 1,
+                               "gaps": gaps[thread],
+                               "unattributed-missing": unattributed[:32],
                                "diff": diff_report(canonical,
                                                    logs[thread])})
         deltas.sort(key=lambda d: -d["edit-distance"])

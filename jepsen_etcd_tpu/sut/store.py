@@ -230,9 +230,13 @@ class Store:
         Raises compacted if rev is at/below the compact horizon.
         """
         if rev <= self.compact_revision:
-            raise SimError("compacted",
+            err = SimError("compacted",
                            f"watch from {rev} <= compacted "
                            f"{self.compact_revision}")
+            # like etcd's WatchResponse.compact_revision: tells the
+            # watcher where it may restart (watch.clj:243-267 retry)
+            err.compact_revision = self.compact_revision
+            raise err
         out: list[Event] = []
         for r, evs in self.events:
             if r >= rev:

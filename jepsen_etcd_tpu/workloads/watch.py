@@ -131,7 +131,7 @@ class WatchClient(WorkloadClient):
         """Watch KEY from revision (exclusive) for ms; returns
         {revision, log} or raises the stream's error
         (watch.clj:139-212)."""
-        state = {"revision": revision, "log": []}
+        state = {"revision": revision, "log": [], "revs": []}
         errors: list = []
 
         def on_events(events):
@@ -151,6 +151,9 @@ class WatchClient(WorkloadClient):
                     return
                 state["revision"] = e.revision
                 state["log"].append(e.kv["value"] if e.kv else None)
+                # parallel revision log: lets the checker attribute a
+                # missing value to a recorded compaction gap precisely
+                state["revs"].append(e.revision)
 
         def on_error(e):
             errors.append(e)
@@ -195,11 +198,12 @@ class WatchClient(WorkloadClient):
                             v["revision"], loop.rng.randint(0, 5000))
                         self._track(w)
                         return {"revision": w["revision"],
-                                "log": v["log"] + w["log"]}
+                                "log": v["log"] + w["log"],
+                                "revs": v.get("revs", []) + w["revs"],
+                                "gaps": v.get("gaps", [])}
                     except (SimError, TimeoutError) as e:
                         # the reference retries EVERY client error here
-                        # (watch.clj:258-261 catches client-error?, incl.
-                        # definite ones like compacted-under-admin) — a
+                        # (watch.clj:258-261 catches client-error?) — a
                         # raise would crash the whole converger; a stuck
                         # watcher surfaces as converge-timeout instead.
                         # A monotonicity violation is retried too, but
@@ -208,6 +212,27 @@ class WatchClient(WorkloadClient):
                         if isinstance(e, SimError) and \
                                 e.type == "nonmonotonic-watch":
                             violations.append(str(e))
+                        if isinstance(e, SimError) and \
+                                e.type == "compacted":
+                            # a watch below the compact horizon can NEVER
+                            # proceed: retrying the same revision stalls
+                            # the converger until timeout (-> unknown).
+                            # Restart past the horizon and record the
+                            # unobservable window so the checker can
+                            # attribute the missing entries
+                            # (watch.clj:243-267 semantics; etcd's
+                            # WatchResponse.compact_revision restart).
+                            new_rev = getattr(e, "compact_revision", None)
+                            if new_rev is None:
+                                new_rev = self.max_revision[0]
+                            if new_rev > v["revision"]:
+                                self.revision[0] = new_rev
+                                return {
+                                    "revision": new_rev,
+                                    "log": v["log"],
+                                    "revs": v.get("revs", []),
+                                    "gaps": v.get("gaps", []) +
+                                            [[v["revision"], new_rev]]}
                         await sleep(1 * SECOND)
                         return v
 

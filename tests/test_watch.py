@@ -267,3 +267,102 @@ def test_edit_distance_pallas_matches_python():
                               force_pallas=True)
     want = [_indel_python(canonical, log) for log in logs]
     assert got == want, (got, want)
+
+
+# ---- compaction gaps (final-watch restart, watch.clj:243-267) -------------
+
+def gapped_ok(p, log, revs, rev, gaps):
+    return {"type": "ok", "process": p, "f": "final-watch",
+            "value": {"revision": rev, "log": log, "revs": revs,
+                      "gaps": gaps}}
+
+
+def full_ok(p, log, revs, rev):
+    return {"type": "ok", "process": p, "f": "final-watch",
+            "value": {"revision": rev, "log": log, "revs": revs}}
+
+
+def test_watch_checker_gap_attributed_valid():
+    """A thread missing exactly the values inside its recorded
+    compaction window is legitimate: the events were destroyed."""
+    h = H(watch_inv(0), full_ok(0, [10, 11, 12, 13], [2, 3, 4, 5], 5),
+          watch_inv(1), full_ok(1, [10, 11, 12, 13], [2, 3, 4, 5], 5),
+          # thread 2 saw 10 (rev 2), was compacted over (2, 4], resumed
+          watch_inv(2), gapped_ok(2, [10, 13], [2, 5], 5, [[2, 4]]))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] is True, r
+
+
+def test_watch_checker_gap_unattributed_invalid():
+    """Missing a value whose revision lies OUTSIDE every recorded gap is
+    a real loss, gap or no gap."""
+    h = H(watch_inv(0), full_ok(0, [10, 11, 12, 13], [2, 3, 4, 5], 5),
+          watch_inv(1), full_ok(1, [10, 11, 12, 13], [2, 3, 4, 5], 5),
+          # gap covers (2, 3] but value 12 (rev 4) is missing too
+          watch_inv(2), gapped_ok(2, [10, 13], [2, 5], 5, [[2, 3]]))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] is False
+    d = [d for d in r["deltas"] if d["thread"] == 2][0]
+    assert 12 in d["unattributed-missing"]
+
+
+def test_watch_checker_gap_out_of_order_invalid():
+    """A gapped log must still be an in-order subsequence of canonical."""
+    h = H(watch_inv(0), full_ok(0, [10, 11, 12, 13], [2, 3, 4, 5], 5),
+          watch_inv(1), full_ok(1, [10, 11, 12, 13], [2, 3, 4, 5], 5),
+          watch_inv(2), gapped_ok(2, [13, 10], [5, 2], 5, [[2, 4]]))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] is False
+
+
+def test_watch_checker_gapped_log_never_defines_canonical():
+    """With one full and one gapped log, canonical is the full one even
+    if the gapped log is longer-listed first."""
+    h = H(watch_inv(2), gapped_ok(2, [10, 13], [2, 5], 5, [[2, 4]]),
+          watch_inv(0), full_ok(0, [10, 11, 12, 13], [2, 3, 4, 5], 5))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] is True, r
+
+
+def test_watch_admin_compaction_gap_e2e(tmp_path):
+    """Aggressive admin (compact/defrag) cadence that compacts under the
+    final watch: the watcher must restart past the compact horizon,
+    record a gap, and the run must end green — this exact scenario used
+    to stall the converger and end `unknown` (VERDICT r2 weak #5)."""
+    from jepsen_etcd_tpu.compose import etcd_test
+    from jepsen_etcd_tpu.runner.test_runner import run_test
+    out = run_test(etcd_test({
+        "workload": "watch", "nemesis": ["admin"],
+        "nemesis_interval": 1.5, "time_limit": 40, "rate": 200,
+        "store_base": str(tmp_path), "seed": 3}))
+    wl = out["results"]["workload"]
+    assert wl["valid?"] is True, wl
+    gapped = [op for op in out["history"]
+              if op.get("type") == "ok" and op.get("f") == "final-watch"
+              and (op.get("value") or {}).get("gaps")]
+    assert gapped, "seed 3 must exercise the compaction-gap restart"
+
+
+def test_watch_checker_all_threads_gapped_merged_canonical():
+    """With every watcher gapped (aggressive admin), canonical must be
+    the union of observations merged by revision — no single gapped log
+    can define consensus without false data-loss verdicts."""
+    h = H(watch_inv(0), gapped_ok(0, [10, 13, 14], [2, 5, 6], 6,
+                                  [[2, 4]]),
+          watch_inv(1), gapped_ok(1, [10, 11, 12, 14], [2, 3, 4, 6], 6,
+                                  [[4, 5]]))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] is True, r
+
+
+def test_watch_checker_all_gapped_real_loss_still_caught():
+    """Union canonical still catches a loss outside every gap window."""
+    h = H(watch_inv(0), gapped_ok(0, [10, 11, 12, 13], [2, 3, 4, 5], 6,
+                                  [[5, 6]]),
+          # thread 1 missed value 12 (rev 4), outside its (5,6] gap
+          watch_inv(1), gapped_ok(1, [10, 11, 13], [2, 3, 5], 6,
+                                  [[5, 6]]))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] is False
+    d = [d for d in r["deltas"] if d["thread"] == 1][0]
+    assert 12 in d["unattributed-missing"]
