@@ -35,6 +35,12 @@ from ...ops.closure import closure_batch_lazy
 
 WW, WR, RW, RT = "ww", "wr", "rw", "realtime"
 
+#: certificate-enumeration bounds per anomaly class: enough to show every
+#: independent cycle in practice without letting one big SCC turn the
+#: checker into an O(E) BFS storm with a thousand-entry result map
+MAX_CERTS_PER_CLASS = 32
+MAX_ANCHOR_SCANS = 512
+
 #: anomaly -> weakest consistency models it rules out (Elle's `not` field)
 ANOMALY_NOT = {
     "G0": ["read-uncommitted"],
@@ -203,28 +209,49 @@ class DepGraph:
             return adjs[li]
 
         def anchored(name: str, anchor_edges, need: int,
-                     forbid: tuple = ()) -> Optional[dict]:
-            """A cycle = anchor edge (a, b) + back-path b->a in level
-            `need`; `forbid` lists weaker levels the back-path must NOT
-            exist at (so the cycle genuinely needs the edges `need`
-            adds, and a weaker anomaly is never re-labeled here)."""
+                     forbid: tuple = ()) -> list[dict]:
+            """ALL cycles of a class: one certificate per anchor edge
+            (a, b) whose back-path b->a exists in level `need`; `forbid`
+            lists weaker levels the back-path must NOT exist at (so the
+            cycle genuinely needs the edges `need` adds, and a weaker
+            anomaly is never re-labeled here). Distinct anchors that
+            close over the same node cycle dedupe to one certificate —
+            Elle likewise enumerates every cycle it finds, not just the
+            first (elle's cycle search reports each anchored cycle)."""
             reach = reach_fn()
+            found: list[dict] = []
+            seen_cycles: set = set()
+            scans = 0
             for (a, b) in sorted(anchor_edges):
+                # bound the enumeration: a densely cyclic history can
+                # have O(E) on-cycle anchors (one BFS each) — Elle
+                # likewise bounds its cycle search rather than emit
+                # thousands of certificates
+                if len(found) >= MAX_CERTS_PER_CLASS or \
+                        scans >= MAX_ANCHOR_SCANS:
+                    break
                 if not reach[need][b, a]:
                     continue
                 if any(reach[f][b, a] for f in forbid):
                     continue
+                scans += 1
                 back = _bfs_path(adj(need), b, a)
-                if back is not None:
-                    return self._record(name, [a] + back)
-            return None
+                if back is None:
+                    continue
+                cycle = [a] + back
+                nodes = cycle[:-1]
+                # canonical rotation: same cycle found from different
+                # anchors collapses to one certificate
+                pivot = nodes.index(min(nodes))
+                key = tuple(nodes[pivot:] + nodes[:pivot])
+                if key in seen_cycles:
+                    continue
+                seen_cycles.add(key)
+                found.append(self._record(name, cycle))
+            return found
 
         recs: list = []
-
-        def add(rec: Optional[dict]) -> bool:
-            if rec is not None:
-                recs.append(rec)
-            return rec is not None
+        add = recs.extend
 
         ww, wr, rw = self.edges[WW], self.edges[WR], self.edges[RW]
         if on_cycle[0].any():

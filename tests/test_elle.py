@@ -337,3 +337,37 @@ def test_sharded_closure_matches_numpy():
     assert (reach == reach_np).all()
     assert (cyc == cyc_np).all()
     assert cyc[1].all()  # the planted ring puts every node on a cycle
+
+
+def test_append_two_independent_g1c_cycles_both_listed():
+    """Certificate completeness (Elle enumerates EVERY cycle found): two
+    disjoint wr cycles — T0/T1 on x/y and T2/T3 on p/q — must both
+    appear under G1c, not just the first."""
+    h = H(inv(0, [["append", "x", 1], ["r", "y", None]]),
+          inv(1, [["append", "y", 1], ["r", "x", None]]),
+          ok(0, [["append", "x", 1], ["r", "y", [1]]]),
+          ok(1, [["append", "y", 1], ["r", "x", [1]]]),
+          inv(2, [["append", "p", 1], ["r", "q", None]]),
+          inv(3, [["append", "q", 1], ["r", "p", None]]),
+          ok(2, [["append", "p", 1], ["r", "q", [1]]]),
+          ok(3, [["append", "q", 1], ["r", "p", [1]]]))
+    r = check_append(h)
+    assert r["valid?"] is False
+    certs = r["anomalies"]["G1c"]
+    assert len(certs) == 2, certs
+    node_sets = {frozenset(c["cycle"]) for c in certs}
+    assert len(node_sets) == 2, "the two cycles must be distinct"
+    for c in certs:
+        assert {s["type"] for s in c["steps"]} == {"wr"}
+
+
+def test_append_same_cycle_not_duplicated():
+    """One cycle reachable from two anchors (both wr edges of the same
+    2-cycle) must yield exactly one certificate."""
+    h = H(inv(0, [["append", "x", 1], ["r", "y", None]]),
+          inv(1, [["append", "y", 1], ["r", "x", None]]),
+          ok(0, [["append", "x", 1], ["r", "y", [1]]]),
+          ok(1, [["append", "y", 1], ["r", "x", [1]]]))
+    r = check_append(h)
+    assert r["valid?"] is False
+    assert len(r["anomalies"]["G1c"]) == 1, r["anomalies"]["G1c"]
