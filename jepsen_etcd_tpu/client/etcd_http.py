@@ -222,8 +222,10 @@ class HttpEtcdClient(Client):
     # ---- leases ------------------------------------------------------------
 
     async def lease_grant(self, ttl_ns: int) -> int:
-        raw = await self._post("/v3/lease/grant",
-                               {"TTL": max(1, int(ttl_ns / SECOND))})
+        # round UP: truncation would grant a 2.9s lease as TTL=2,
+        # expiring earlier than the harness's lease math assumes
+        ttl_s = max(1, -(-int(ttl_ns) // SECOND))
+        raw = await self._post("/v3/lease/grant", {"TTL": ttl_s})
         return int(raw["ID"])
 
     async def lease_revoke(self, lease_id: int) -> None:
@@ -262,6 +264,18 @@ class HttpEtcdClient(Client):
         loop = current_loop()
         stop = {"flag": False, "resp": None}
 
+        def _shutdown_socket(resp) -> None:
+            # resp.close() would deadlock on the buffered-reader lock a
+            # blocked readline holds; shutting down the RAW socket
+            # unblocks it immediately
+            try:
+                sock = resp.fp.raw._sock if resp is not None else None
+                if sock is not None:
+                    import socket as _socket
+                    sock.shutdown(_socket.SHUT_RDWR)
+            except Exception:
+                pass  # already closed / implementation detail moved
+
         def reader():
             body = json.dumps({"create_request": {
                 "key": _key64(k),
@@ -272,6 +286,14 @@ class HttpEtcdClient(Client):
             try:
                 with urllib.request.urlopen(req, timeout=3600) as resp:
                     stop["resp"] = resp
+                    if stop["flag"]:
+                        # cancel() ran while the connection was still
+                        # being established (before resp existed): its
+                        # socket shutdown missed, so do it ourselves or
+                        # this daemon thread pins the connection until
+                        # the 1h read timeout
+                        _shutdown_socket(resp)
+                        return
                     for line in resp:
                         if stop["flag"]:
                             return
@@ -309,21 +331,11 @@ class HttpEtcdClient(Client):
 
         class _Cancel:
             def cancel(self_inner):
+                # order matters for the connect race: set the flag FIRST
+                # so a reader that assigns stop['resp'] after this call
+                # sees it and shuts its own socket down (see reader())
                 stop["flag"] = True
-                # resp.close() would deadlock on the buffered-reader
-                # lock the blocked readline holds; shutting down the
-                # RAW socket unblocks it immediately (against real
-                # etcd a flag-only cancel would pin the thread and
-                # connection until the 1h read timeout)
-                resp = stop.get("resp")
-                try:
-                    sock = resp.fp.raw._sock if resp is not None \
-                        else None
-                    if sock is not None:
-                        import socket as _socket
-                        sock.shutdown(_socket.SHUT_RDWR)
-                except Exception:
-                    pass  # already closed / implementation detail moved
+                _shutdown_socket(stop.get("resp"))
 
         return _Cancel()
 

@@ -10,10 +10,79 @@ from __future__ import annotations
 
 import itertools
 import json
+import logging
 import os
+import shutil
 from typing import Any
 
+logger = logging.getLogger("jepsen_etcd_tpu.store")
+
 _seq = itertools.count()
+
+#: total store size cap: once exceeded, oldest runs are deleted after
+#: each save (long test-all sweeps write GBs of artifacts and would
+#: otherwise fill the disk). 0 disables rotation.
+STORE_MAX_BYTES = int(os.environ.get(
+    "JEPSEN_ETCD_TPU_STORE_MAX_BYTES", 2 * 1024 ** 3))
+
+
+def _dir_size(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+def rotate_store(base: str, keep_dir: str = None,
+                 max_bytes: int = None) -> list[str]:
+    """Delete oldest run dirs until the store fits under max_bytes.
+    The run at keep_dir (the one just written) is never deleted;
+    dangling `latest` symlinks left by a deletion are removed."""
+    max_bytes = STORE_MAX_BYTES if max_bytes is None else max_bytes
+    if max_bytes <= 0 or not os.path.isdir(base):
+        return []
+    keep = os.path.abspath(keep_dir) if keep_dir else None
+    runs = []
+    for test_name in sorted(os.listdir(base)):
+        td = os.path.join(base, test_name)
+        if os.path.islink(td) or not os.path.isdir(td):
+            continue
+        for run_id in sorted(os.listdir(td)):
+            rd = os.path.join(td, run_id)
+            if os.path.islink(rd) or not os.path.isdir(rd):
+                continue
+            try:
+                mtime = os.path.getmtime(rd)
+            except OSError:
+                continue
+            runs.append((mtime, rd, _dir_size(rd)))
+    total = sum(s for _, _, s in runs)
+    removed: list[str] = []
+    for _, rd, size in sorted(runs):
+        if total <= max_bytes:
+            break
+        if keep and os.path.abspath(rd) == keep:
+            continue
+        shutil.rmtree(rd, ignore_errors=True)
+        total -= size
+        removed.append(rd)
+    if removed:
+        logger.info("store rotation: removed %d old runs (%s over cap)",
+                    len(removed), base)
+        for link in [os.path.join(base, "latest")] + [
+                os.path.join(base, t, "latest")
+                for t in os.listdir(base)
+                if os.path.isdir(os.path.join(base, t))]:
+            if os.path.islink(link) and not os.path.exists(link):
+                try:
+                    os.unlink(link)  # dangling after rotation
+                except OSError:
+                    pass
+    return removed
 
 
 def make_store_dir(base: str, test_name: str) -> str:
@@ -24,7 +93,11 @@ def make_store_dir(base: str, test_name: str) -> str:
     os.makedirs(base, exist_ok=True)
     existing = sorted(os.listdir(os.path.join(base, test_name))) \
         if os.path.isdir(os.path.join(base, test_name)) else []
-    run_id = f"{len([e for e in existing if not e.startswith('latest')]):05d}"
+    # max+1, NOT count: rotation deletes the lowest-numbered (oldest)
+    # runs, so a count could collide with a surviving higher id and
+    # silently overwrite its artifacts
+    ids = [int(e) for e in existing if e.isdigit()]
+    run_id = f"{(max(ids) + 1 if ids else 0):05d}"
     path = os.path.join(base, test_name, run_id)
     os.makedirs(path, exist_ok=True)
     return path
@@ -76,3 +149,6 @@ def save_run(store_dir: str, test: dict, history, results: dict,
         os.makedirs(nd, exist_ok=True)
         with open(os.path.join(nd, "etcd.log"), "w") as f:
             f.write("\n".join(lines))
+    # keep long sweeps from filling the disk; never touches this run
+    rotate_store(os.path.dirname(os.path.dirname(store_dir)),
+                 keep_dir=store_dir)

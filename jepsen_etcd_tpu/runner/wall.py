@@ -35,6 +35,7 @@ class WallLoop(SimLoop):
         self._external: deque = deque()
         self._t0 = time.monotonic_ns()
         self._pool = ThreadPoolExecutor(max_workers=pool_size)
+        self._in_flight = 0  # pool submissions whose callback hasn't run
 
     def _wall(self) -> int:
         return time.monotonic_ns() - self._t0
@@ -51,14 +52,21 @@ class WallLoop(SimLoop):
         """Run blocking fn on the pool; resolve a loop Future with its
         result (exceptions propagate)."""
         fut = self.future()
+        with self._cond:
+            self._in_flight += 1
+
+        def _finish(resolve, value):
+            with self._cond:
+                self._in_flight -= 1
+            resolve(value)
 
         def work():
             try:
                 r = fn(*args, **kwargs)
             except BaseException as e:
-                self.call_soon_threadsafe(fut.set_exception, e)
+                self.call_soon_threadsafe(_finish, fut.set_exception, e)
             else:
-                self.call_soon_threadsafe(fut.set_result, r)
+                self.call_soon_threadsafe(_finish, fut.set_result, r)
 
         self._pool.submit(work)
         return fut
@@ -94,7 +102,12 @@ class WallLoop(SimLoop):
                     continue
                 while self._heap and self._heap[0][2] is None:
                     heapq.heappop(self._heap)  # drop cancelled heads
-                if not self._heap and until is None:
+                # idle only when no timers AND no pool work in flight:
+                # a pending run_in_thread completion arrives via
+                # call_soon_threadsafe and must not be dropped by an
+                # early exit
+                if not self._heap and self._in_flight == 0 \
+                        and until is None:
                     return None
                 timeout = 0.1  # bounded: external work may arrive anytime
                 if self._heap:

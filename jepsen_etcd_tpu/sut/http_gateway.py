@@ -96,13 +96,27 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path
         try:
             if path == "/v3/kv/range":
+                # full Range semantics: optional range_end (half-open
+                # interval; "\0" = from key onward) and limit, so real
+                # etcd tooling (etcdctl get --prefix) gets correct
+                # results. serializable is accepted and identical here:
+                # a single-node gateway has no stale followers.
+                key = _unkey(body["key"])
+                range_end = _unkey(body["range_end"]) \
+                    if body.get("range_end") else None
+                limit = int(body.get("limit", 0))
                 with st.lock:
-                    kv = st.store.get(_unkey(body["key"]))
+                    kvs = st.store.range_interval(key, range_end)
                     rev = st.store.revision
+                more = bool(limit) and len(kvs) > limit
+                count = len(kvs)
+                if limit:
+                    kvs = kvs[:limit]
                 return self._json({
                     "header": {"revision": str(rev)},
-                    "kvs": [st.kv_wire(kv)] if kv else [],
-                    "count": "1" if kv else "0"})
+                    "kvs": [st.kv_wire(kv) for kv in kvs],
+                    "more": more,
+                    "count": str(count)})
             if path == "/v3/kv/txn":
                 return self._txn(body)
             if path == "/v3/kv/compaction":

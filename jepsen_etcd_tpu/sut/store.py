@@ -124,6 +124,19 @@ class Store:
         out.sort(key=lambda kv: kv["key"])
         return out
 
+    def range_interval(self, start: str,
+                       end: Optional[str] = None) -> list[dict]:
+        """etcd Range semantics: end None -> the single key `start`;
+        end "\\0" -> every key >= start; else the half-open interval
+        [start, end)."""
+        if end is None:
+            kv = self.get(start)
+            return [kv] if kv else []
+        out = [ks.as_kv(k) for k, ks in self.kvs.items()
+               if k >= start and (end == "\x00" or k < end)]
+        out.sort(key=lambda kv: kv["key"])
+        return out
+
     # -- txn evaluation -----------------------------------------------------
 
     def _cmp_value(self, key: str, target: str) -> Any:
