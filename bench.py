@@ -23,7 +23,8 @@ CONCURRENCY = 8
 BASELINE_SECONDS = 60.0  # CPU Knossos budget it cannot meet
 
 
-def sim_register_history(n_ops, concurrency, seed=2026, name="bench"):
+def sim_register_history(n_ops, concurrency, seed=2026, name="bench",
+                         nodes=None):
     """n_ops on ONE key via the simulated cluster (fast: virtual time)."""
     from jepsen_etcd_tpu.compose import etcd_test
     from jepsen_etcd_tpu.runner.test_runner import run_test
@@ -36,6 +37,12 @@ def sim_register_history(n_ops, concurrency, seed=2026, name="bench"):
         "workload": "none",
         "time_limit": 3600, "rate": 0, "seed": seed,
         "concurrency": concurrency, "store_base": "store",
+        **({"nodes": nodes} if nodes else {}),
+        # generation is checker-input prep, not the thing benchmarked:
+        # frequent snapshots make the sim O(ops * store-size) (every
+        # count applies re-encodes the whole store and triggers
+        # follower installs)
+        "snapshot_count": 100_000,
     })
     test["name"] = name
     test["client"] = RegisterClient()
@@ -164,7 +171,8 @@ def bench_batched_keys():
 
     K = 64
     test = etcd_test({"workload": "none", "time_limit": 3600, "rate": 0,
-                      "seed": 3, "concurrency": 8, "store_base": "store"})
+                      "seed": 3, "concurrency": 8, "store_base": "store",
+                      "snapshot_count": 100_000})
     test["name"] = "bench-batched-keys"
     test["client"] = RegisterClient()
     test["checker"] = Noop()
@@ -203,6 +211,87 @@ def bench_batched_keys():
     return {"value": round(prod_s, 4), "unit": "s", "keys": K,
             "kernel_s": round(dt, 4), "production_s": round(prod_s, 4),
             "engines": engines,
+            "keys_per_s": round(K / max(prod_s, 1e-9), 1),
+            "vs_baseline": round(BASELINE_SECONDS / max(prod_s, 1e-9), 1)}
+
+
+def bench_register_50k():
+    """Scale cell (VERDICT r3 #7): >=50k-op single-key history — 5x the
+    north star — recording where the ladder/spill boundaries land."""
+    from jepsen_etcd_tpu.ops import wgl
+    t0 = time.time()
+    h = sim_register_history(67_500, CONCURRENCY, seed=17,
+                             name="bench-register-50k",
+                             nodes=["n1", "n2", "n3"])
+    note(f"50k: generated {len(h)} ops in {time.time()-t0:.1f}s")
+    p = wgl.pack_register_history(h)
+    assert p.ok, p.reason
+    wgl.check_packed(p)  # warmup: compile + first search
+    t1 = time.time()
+    out = wgl.check_packed(p)
+    dt = time.time() - t1
+    note(f"50k: verdict={out['valid?']} waves={out.get('waves')} "
+         f"peak={out.get('peak-frontier')} w={p.w} "
+         f"spilled={out.get('spilled')} in {dt:.3f}s")
+    assert out["valid?"] is True, out
+    return {"value": round(dt, 4), "unit": "s", "ops": p.R, "w": p.w,
+            "waves": out.get("waves"),
+            "peak_frontier": out.get("peak-frontier"),
+            "spilled": bool(out.get("spilled")),
+            "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
+
+
+def bench_batched_512_keys():
+    """Scale cell (VERDICT r3 #7): 512 independent keys in vmapped
+    kernel launches, key axis sharded over the device mesh — the key-DP
+    axis at 8x the round-2 batch."""
+    from jepsen_etcd_tpu.compose import etcd_test
+    from jepsen_etcd_tpu.runner.test_runner import run_test
+    from jepsen_etcd_tpu.generators import limit, mix, reserve, independent
+    from jepsen_etcd_tpu.generators.independent import subhistory
+    from jepsen_etcd_tpu.core.history import History
+    from jepsen_etcd_tpu.workloads.register import RegisterClient, r, w, cas
+    from jepsen_etcd_tpu.checkers.core import Noop
+    from jepsen_etcd_tpu.ops import wgl
+
+    K = 512
+    t0 = time.time()
+    # 3 nodes: replication fan-out dominates generation wall-clock and
+    # the checker input doesn't care about cluster size
+    test = etcd_test({"workload": "none", "time_limit": 36_000, "rate": 0,
+                      "seed": 29, "concurrency": 16, "store_base": "store",
+                      "nodes": ["n1", "n2", "n3"],
+                      "snapshot_count": 100_000})
+    test["name"] = "bench-batched-512"
+    test["client"] = RegisterClient()
+    test["checker"] = Noop()
+    test["generator"] = independent.concurrent_generator(
+        16, list(range(K)),
+        lambda k: limit(100, reserve(8, r, mix([w, cas]))))
+    out = run_test(test)
+    subs = {k: History(subhistory(out["history"], k)) for k in range(K)}
+    note(f"512-key: generated {len(out['history'])} ops "
+         f"in {time.time()-t0:.1f}s")
+    packs = [wgl.pack_register_history(subs[k]) for k in range(K)]
+    assert all(p.ok for p in packs), [p.reason for p in packs if not p.ok]
+    wgl.check_packed_batch(packs)  # warmup compiles
+    t1 = time.time()
+    results = wgl.check_packed_batch(packs)
+    kernel_s = time.time() - t1
+    valid = sum(1 for res in results if res.get("valid?") is True)
+    assert valid == K, f"only {valid}/{K} valid"
+    # production path (size cutoff routes these to the native engine)
+    from jepsen_etcd_tpu.checkers.tpu_linearizable import (
+        TPULinearizableChecker)
+    t1 = time.time()
+    pres = TPULinearizableChecker().check_batch({}, subs)
+    prod_s = time.time() - t1
+    assert all(res["valid?"] is True for res in pres.values())
+    note(f"512-key: kernel={kernel_s:.3f}s production={prod_s:.3f}s "
+         f"({K/max(prod_s,1e-9):.0f} keys/s)")
+    return {"value": round(prod_s, 4), "unit": "s", "keys": K,
+            "kernel_s": round(kernel_s, 4),
+            "production_s": round(prod_s, 4),
             "keys_per_s": round(K / max(prod_s, 1e-9), 1),
             "vs_baseline": round(BASELINE_SECONDS / max(prod_s, 1e-9), 1)}
 
@@ -298,6 +387,8 @@ def main() -> int:
                      ("deep_wgl_4n_2000", bench_deep_wgl),
                      ("faulted_register", bench_faulted_register),
                      ("batched_64_keys", bench_batched_keys),
+                     ("register_50k", bench_register_50k),
+                     ("batched_512_keys", bench_batched_512_keys),
                      ("set_full", bench_set),
                      ("elle_append_device", bench_elle_append),
                      ("watch_edit_distance", bench_watch)]:

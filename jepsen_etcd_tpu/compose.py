@@ -109,6 +109,10 @@ def etcd_test(opts: dict) -> dict:
     test = dict(o)
     test.update({
         "name": name.replace(" ", "-"),
+        # the fault-name list survives here: test["nemesis"] below is
+        # the live nemesis OBJECT, which save_run excludes from
+        # test.json — the spec is what run reports need
+        "nemesis_spec": list(o["nemesis"]),
         "client": workload["client"],
         "generator": phases(*[p for p in phase_list if p is not None]),
         "checker": checker,

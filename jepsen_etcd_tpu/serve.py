@@ -1,9 +1,10 @@
 """``serve``: browse saved test runs over local HTTP.
 
 Re-designs the reference's ``lein run serve`` (etcd.clj:250-252, jepsen's
-built-in web server): the store dir is served with a generated index of
-runs at ``/`` — each linking its results.json, timeline.html, perf PNGs,
-trace, and node logs — and plain file/directory serving below it.
+built-in web server): ``/`` renders a run index (name, time, ops,
+valid? badge); each run dir renders a report page — test parameters,
+per-checker verdicts, inline perf/clock plots, artifact links — with
+plain file serving below it (``?files`` forces the raw listing).
 """
 
 from __future__ import annotations
@@ -11,8 +12,34 @@ from __future__ import annotations
 import html
 import json
 import os
+import time
 from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import quote
+
+_CSS = """
+body{font-family:sans-serif;margin:2em;max-width:70em}
+table{border-collapse:collapse}
+td,th{border:1px solid #ccc;padding:4px 8px;text-align:left}
+.ok{color:#2a2;font-weight:bold}
+.bad{color:#c22;font-weight:bold}
+.unk{color:#b80;font-weight:bold}
+img{max-width:100%;border:1px solid #ddd;margin:4px 0}
+code{background:#f4f4f4;padding:1px 4px}
+"""
+
+
+def _badge(v) -> str:
+    cls = {"True": "ok", True: "ok", False: "bad", "False": "bad"}.get(
+        v, "unk")
+    return f'<span class="{cls}">{html.escape(str(v))}</span>'
+
+
+def _load_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
 
 
 def _run_rows(store_base: str) -> list[dict]:
@@ -20,63 +47,131 @@ def _run_rows(store_base: str) -> list[dict]:
     rows = []
     for rdir in all_runs(store_base):
         rel = os.path.relpath(rdir, store_base)
-        row = {"dir": rel, "valid?": "?", "files": []}
-        results = os.path.join(rdir, "results.json")
-        if os.path.exists(results):
-            try:
-                with open(results) as f:
-                    row["valid?"] = json.load(f).get("valid?")
-            except (OSError, json.JSONDecodeError):
-                row["valid?"] = "unreadable"
-        for fn in sorted(os.listdir(rdir)):
-            row["files"].append(fn)
-        rows.append(row)
+        results = _load_json(os.path.join(rdir, "results.json")) or {}
+        test = _load_json(os.path.join(rdir, "test.json")) or {}
+        try:
+            mtime = os.path.getmtime(rdir)
+        except OSError:
+            mtime = 0
+        ops = (results.get("stats") or {}).get("count")
+        rows.append({"dir": rel, "mtime": mtime,
+                     "valid?": results.get("valid?", "?"),
+                     "name": test.get("name", rel.split(os.sep)[0]),
+                     "time_limit": test.get("time_limit"),
+                     "ops": ops})
+    rows.sort(key=lambda r: r["mtime"], reverse=True)
     return rows
 
 
 def index_html(store_base: str) -> str:
     rows = []
-    # newest first by mtime — run ids are per-test sequence numbers, so
-    # path order is not recency across test names
-    ordered = sorted(
-        _run_rows(store_base),
-        key=lambda r: os.path.getmtime(os.path.join(store_base, r["dir"])),
-        reverse=True)
-    for r in ordered:
-        color = {"True": "#2a2", True: "#2a2",
-                 False: "#c22", "False": "#c22"}.get(r["valid?"], "#b80")
-        files = " ".join(
-            f'<a href="/{quote(r["dir"])}/{quote(fn)}">{html.escape(fn)}</a>'
-            for fn in r["files"])
+    for r in _run_rows(store_base):
+        when = time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(r["mtime"]))
         rows.append(
             f'<tr><td><a href="/{quote(r["dir"])}/">'
             f'{html.escape(r["dir"])}</a></td>'
-            f'<td style="color:{color}">{html.escape(str(r["valid?"]))}</td>'
-            f"<td>{files}</td></tr>")
-    return ("<!doctype html><title>jepsen_etcd_tpu store</title>"
+            f"<td>{html.escape(when)}</td>"
+            f"<td>{_badge(r['valid?'])}</td>"
+            f"<td>{r['ops'] if r['ops'] is not None else ''}</td></tr>")
+    return (f"<!doctype html><title>jepsen_etcd_tpu store</title>"
+            f"<style>{_CSS}</style>"
             "<h1>Test runs</h1>"
-            "<table border=1 cellpadding=4><tr><th>run</th>"
-            "<th>valid?</th><th>artifacts</th></tr>"
+            "<table><tr><th>run</th><th>time</th>"
+            "<th>valid?</th><th>ops</th></tr>"
             + "".join(rows) + "</table>")
 
 
+#: test.json keys shown in the run page's parameter table, in order
+_PARAM_KEYS = ("workload", "nemesis_spec", "nemesis_interval",
+               "time_limit", "rate", "ops_per_key", "concurrency",
+               "serializable", "lazyfs", "client_type", "snapshot_count",
+               "unsafe_no_fsync", "corrupt_check", "version", "seed",
+               "nodes")
+
+
+def run_html(store_base: str, rel: str) -> str:
+    """The per-run report page (jepsen's run view: params, checker
+    verdicts, plots, artifacts)."""
+    rdir = os.path.join(store_base, rel)
+    results = _load_json(os.path.join(rdir, "results.json")) or {}
+    test = _load_json(os.path.join(rdir, "test.json")) or {}
+    out = [f"<!doctype html><title>{html.escape(rel)}</title>",
+           f"<style>{_CSS}</style>",
+           f'<p><a href="/">&larr; all runs</a> &middot; '
+           f'<a href="/{quote(rel)}/?files">raw files</a></p>',
+           f"<h1>{html.escape(test.get('name', rel))} "
+           f"{_badge(results.get('valid?', '?'))}</h1>"]
+    # parameters
+    params = [(k, test[k]) for k in _PARAM_KEYS if k in test]
+    if params:
+        out.append("<h2>Parameters</h2><table>")
+        out.extend(
+            f"<tr><th>{html.escape(k)}</th>"
+            f"<td><code>{html.escape(json.dumps(v))}</code></td></tr>"
+            for k, v in params)
+        out.append("</table>")
+    # checker verdicts
+    checkers = [(k, v) for k, v in sorted(results.items())
+                if isinstance(v, dict) and "valid?" in v]
+    if checkers:
+        out.append("<h2>Checkers</h2><table>"
+                   "<tr><th>checker</th><th>valid?</th><th>detail</th></tr>")
+        for k, v in checkers:
+            detail = {dk: dv for dk, dv in v.items() if dk != "valid?"}
+            blob = html.escape(json.dumps(detail, default=repr)[:2000])
+            out.append(f"<tr><td>{html.escape(k)}</td>"
+                       f"<td>{_badge(v.get('valid?'))}</td>"
+                       f"<td><code>{blob}</code></td></tr>")
+        out.append("</table>")
+    # plots inline
+    plots = [f for f in ("latency-raw.png", "rate.png", "clock.png")
+             if os.path.exists(os.path.join(rdir, f))]
+    if plots:
+        out.append("<h2>Plots</h2>")
+        out.extend(f'<img src="/{quote(rel)}/{quote(f)}" alt="{f}">'
+                   for f in plots)
+    # artifacts
+    out.append("<h2>Artifacts</h2><ul>")
+    for fn in sorted(os.listdir(rdir)):
+        p = os.path.join(rdir, fn)
+        label = fn + ("/" if os.path.isdir(p) else "")
+        out.append(f'<li><a href="/{quote(rel)}/{quote(fn)}">'
+                   f"{html.escape(label)}</a></li>")
+    out.append("</ul>")
+    return "".join(out)
+
+
 class StoreHandler(SimpleHTTPRequestHandler):
-    """Serves the store dir; '/' renders the generated run index."""
+    """Serves the store dir; '/' renders the run index, run dirs render
+    report pages (?files for the raw listing)."""
 
     store_base = "store"
 
     def __init__(self, *args, **kw):
         super().__init__(*args, directory=self.store_base, **kw)
 
+    def _html(self, body: str) -> None:
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):
-        if self.path in ("/", "/index.html"):
-            body = index_html(self.store_base).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return
+        from urllib.parse import parse_qs
+        path, _, query = self.path.partition("?")
+        if path in ("/", "/index.html"):
+            return self._html(index_html(self.store_base))
+        want_files = "files" in parse_qs(query, keep_blank_values=True)
+        if path.endswith("/") and not want_files:
+            rel = os.path.normpath(path.strip("/"))
+            rdir = os.path.join(self.store_base, rel)
+            # only render report pages for real run dirs inside the store
+            if not rel.startswith("..") and \
+                    os.path.exists(os.path.join(rdir, "results.json")):
+                return self._html(run_html(self.store_base, rel))
         super().do_GET()
 
     def log_message(self, fmt, *args):  # quiet by default

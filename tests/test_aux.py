@@ -134,11 +134,21 @@ def test_serve_store(tmp_path):
         idx = urllib.request.urlopen(
             f"http://127.0.0.1:{port}/").read().decode()
         rel = os.path.relpath(out["dir"], str(tmp_path))
-        assert rel in idx and "results.json" in idx
+        assert rel in idx and "valid?" in idx
+        # run report page: params, per-checker verdicts, artifacts
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/{rel}/").read().decode()
+        assert "Parameters" in page and "Checkers" in page
+        assert "results.json" in page and "workload" in page
+        # raw artifacts still served
         res = urllib.request.urlopen(
             f"http://127.0.0.1:{port}/{rel}/results.json")
         assert res.status == 200
         assert json.load(res).get("valid?") is True
+        # ?files bypasses the report page for the raw listing
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/{rel}/?files").read().decode()
+        assert "history.jsonl" in raw
     finally:
         srv.shutdown()
         srv.server_close()
