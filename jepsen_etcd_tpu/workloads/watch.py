@@ -19,7 +19,7 @@ import itertools
 from typing import Any, Callable, Optional
 
 from ..core.op import Op
-from ..client import with_errors
+from ..client import with_errors, client as make_client
 from ..checkers.watch import WatchChecker
 from ..generators import reserve, each_thread
 from ..runner.sim import current_loop, sleep, Event, SECOND
@@ -171,6 +171,27 @@ class WatchClient(WorkloadClient):
         self.revision[0] = res["revision"]
         self.max_revision[0] = max(self.max_revision[0], res["revision"])
 
+    def _failover(self, test: dict) -> None:
+        """Re-pin the connection to a current member. jetcd is built
+        with EVERY endpoint and its channel fails over internally when
+        a member dies or is removed (client.clj's connect takes the
+        full node list); the sim client pins one node, so a watcher
+        whose node was shrunk away would otherwise retry connect-failed
+        until the converger times out (-> unknown)."""
+        db = test.get("db")
+        members = sorted(getattr(db, "members", None) or test["nodes"])
+        others = [m for m in members if m != self.node] or members
+        if not others:
+            return
+        loop = current_loop()
+        new = others[loop.rng.randrange(len(others))]
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        self.conn = make_client(test, new)
+        self.node = new
+
     # -- ops -----------------------------------------------------------------
 
     async def invoke(self, test: dict, op: Op) -> Op:
@@ -212,6 +233,11 @@ class WatchClient(WorkloadClient):
                         if isinstance(e, SimError) and \
                                 e.type == "nonmonotonic-watch":
                             violations.append(str(e))
+                        if isinstance(e, SimError) and \
+                                e.type == "connect-failed":
+                            # node dead or shrunk away: fail over like
+                            # jetcd's multi-endpoint channel would
+                            self._failover(test)
                         if isinstance(e, SimError) and \
                                 e.type == "compacted":
                             # a watch below the compact horizon can NEVER
@@ -256,9 +282,22 @@ class WatchClient(WorkloadClient):
                                 extra_error=["converge-timeout"])
             raise ValueError(f"unknown f {op.f}")
 
+        async def go_with_failover():
+            # every op re-pins on connect-failed (jetcd's channel fails
+            # over for ALL calls, not just final-watch retries); the op
+            # itself still fails honestly — the NEXT op uses the new
+            # member
+            try:
+                return await go()
+            except SimError as e:
+                if e.type == "connect-failed":
+                    self._failover(test)
+                raise
+
         # watch ops must fail definitely: an indefinite error would spin
         # up a fresh client whose re-watch duplicates log entries
-        return await with_errors(op, {"watch", "final-watch"}, go)
+        return await with_errors(op, {"watch", "final-watch"},
+                                 go_with_failover)
 
 
 def workload(opts: dict) -> dict:

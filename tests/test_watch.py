@@ -366,3 +366,19 @@ def test_watch_checker_all_gapped_real_loss_still_caught():
     assert r["valid?"] is False
     d = [d for d in r["deltas"] if d["thread"] == 1][0]
     assert 12 in d["unattributed-missing"]
+
+
+def test_watch_member_failover_e2e(tmp_path):
+    """A watcher pinned to a node the member nemesis shrinks away must
+    fail over to a current member (jetcd's multi-endpoint channel
+    semantics) — previously it retried connect-failed until the
+    converger timed out and the run ended unknown."""
+    from jepsen_etcd_tpu.compose import etcd_test
+    from jepsen_etcd_tpu.runner.test_runner import run_test
+    out = run_test(etcd_test({
+        "workload": "watch", "nemesis": ["member", "admin"],
+        "time_limit": 30, "rate": 100,
+        "store_base": str(tmp_path), "seed": 0}))
+    wl = out["results"]["workload"]
+    assert wl["valid?"] is True, wl
+    assert out["valid?"] is True
