@@ -28,11 +28,23 @@ logger = logging.getLogger("jepsen_etcd_tpu.checkers")
 #: register.clj:110-112 (one checker, engine picked by problem size).
 CPU_CUTOFF = 512
 
+#: mid-size band: up to here the DFS still gets first shot, but with a
+#: budget scaled to history size instead of the flat 1M cheap-shot cap.
+#: Measured (single v5e + this host): DFS witness search is ~R configs
+#: x O(n) entry scan ~= 1.5 ns per config-entry, so a valid 16k-entry
+#: history answers in ~0.2 s where the kernel pays ~0.3 s dispatch +
+#: 116 us/op ~= 1.2 s; past ~70k entries the DFS's quadratic term loses
+#: to the kernel's linear wave count. 16384 caps the worst case (budget
+#: exhausted on an adversarial history, then the kernel runs anyway) at
+#: roughly one kernel-run's worth of wasted time.
+DFS_FIRST_MAX = 16_384
+
 
 class TPULinearizableChecker(Checker):
     def __init__(self, model_fn=None, fallback: bool = True,
                  f_max: Optional[int] = None,
-                 cpu_cutoff: Optional[int] = CPU_CUTOFF):
+                 cpu_cutoff: Optional[int] = CPU_CUTOFF,
+                 dfs_first_max: Optional[int] = DFS_FIRST_MAX):
         self.model_fn = model_fn or (lambda: VersionedRegister(0, None))
         self.fallback = fallback
         self.f_max = f_max
@@ -40,28 +52,48 @@ class TPULinearizableChecker(Checker):
         # harness's way of pinning the TPU path), so the size cutoff
         # only applies when CPU routing is allowed at all
         self.cpu_cutoff = cpu_cutoff if fallback else None
+        # the mid band rides on the cutoff: without CPU routing at all
+        # (cpu_cutoff None pins the kernel) the band must be off too
+        self.dfs_first_max = dfs_first_max if self.cpu_cutoff else None
 
     #: cutoff-DFS budget: the "cheap shot" size (same cap _fallback uses
     #: for blowup histories) — a small history that exhausts this gets
     #: the kernel's complete BFS instead of more DFS
     CUTOFF_MAX_CONFIGS = 1_000_000
+    #: check_history's default budget: what _fallback spends when the
+    #: kernel can't take a history at all
+    FALLBACK_MAX_CONFIGS = 5_000_000
 
     def _small_history_check(
-            self, history) -> tuple[Optional[dict], Optional[dict]]:
+            self, history,
+            band: Optional[int] = None
+    ) -> tuple[Optional[dict], Optional[dict], int]:
         """Size-cutoff routing: below CPU_CUTOFF the native DFS wins by
-        orders of magnitude over device dispatch. Returns (result,
-        unknown): result is the definitive answer or None; unknown
-        carries the budget-exhausted verdict so callers that later fail
-        to reach the kernel can return it instead of re-running the
-        same DFS."""
-        if not self.cpu_cutoff or len(history) > self.cpu_cutoff:
-            return None, None
-        out = check_history(self.model_fn(), history,
-                            max_configs=self.CUTOFF_MAX_CONFIGS)
+        orders of magnitude over device dispatch; up to the mid-size
+        band it still goes first with a size-scaled budget (measured
+        crossover in DFS_FIRST_MAX's comment). Returns (result, unknown,
+        budget): result is the definitive answer or None; unknown
+        carries a budget-exhausted verdict with the budget it spent, so
+        callers that later fail to reach the kernel can decide whether
+        that search already covered what _fallback would spend."""
+        n = len(history)
+        if band is None:
+            band = max(self.cpu_cutoff or 0, self.dfs_first_max or 0)
+        if not self.cpu_cutoff or n > band:
+            return None, None, 0
+        if n <= self.cpu_cutoff:
+            budget = self.CUTOFF_MAX_CONFIGS
+        else:
+            # mid-size band: a valid history's witness costs ~R = n/2
+            # configs, so 4n + 10k is ~8x that with floor headroom,
+            # while an exhausted budget wastes at most about one
+            # kernel-run of time before the kernel gets the history
+            budget = 4 * n + 10_000
+        out = check_history(self.model_fn(), history, max_configs=budget)
         out["checker"] = "cpu-oracle"
         out["engine-route"] = "size-cutoff"
         if out["valid?"] == "unknown":
-            return None, out
+            return None, out, budget
         # report the indefinite-entry count like the kernel result does
         # (wgl.check_packed's "info-ops"): entries the search may decline
         # to linearize — :info completions AND still-open invokes
@@ -69,7 +101,7 @@ class TPULinearizableChecker(Checker):
         entries = history_entries(history) or []
         out.setdefault("info-ops",
                        sum(1 for e in entries if not e.required))
-        return out, None
+        return out, None, budget
 
     def _pack_fn(self):
         """The kernel packing for this model, or None for CPU-only
@@ -86,9 +118,13 @@ class TPULinearizableChecker(Checker):
             return wgl.pack_mutex_history
         return None
 
-    def _finalize(self, history, out: dict, pack=None) -> dict:
+    def _finalize(self, history, out: dict, pack=None,
+                  band=(None, None, 0)) -> dict:
         """Post-process one kernel verdict into a checker result,
-        attaching CPU counterexample diagnostics / fallback as needed."""
+        attaching CPU counterexample diagnostics / fallback as needed.
+        band is the (result, unknown, budget) triple from a prior
+        _small_history_check, so the fallback can skip a DFS that
+        already ran with at least the budget it would spend."""
         if out["valid?"] is True:
             out["checker"] = "tpu-wgl"
             return out
@@ -104,8 +140,9 @@ class TPULinearizableChecker(Checker):
             return out
         if out.get("overflow") and pack is not None:
             return self._overflow(history, pack, out)
-        return self._fallback(history, out.get("reason", "unknown"),
-                              blowup=bool(out.get("blowup")))
+        return self._fallback_after_band(
+            history, out.get("reason", "unknown"),
+            bool(out.get("blowup")), band[1], band[2])
 
     def _overflow(self, history, pack, out: dict) -> dict:
         """Top-rung frontier overflow: a DFS needs only one witness
@@ -142,37 +179,50 @@ class TPULinearizableChecker(Checker):
         # certainly can't finish either — give it a cheap shot (it can
         # still find a witness for valid histories fast) instead of
         # burning the full budget for minutes per key
-        kwargs = {"max_configs": 1_000_000} if blowup else {}
-        out = check_history(self.model_fn(), history, **kwargs)
+        budget = self.CUTOFF_MAX_CONFIGS if blowup \
+            else self.FALLBACK_MAX_CONFIGS
+        out = check_history(self.model_fn(), history, max_configs=budget)
         out["checker"] = "cpu-oracle"
         out["tpu-fallback-reason"] = reason
         return out
 
-    def check(self, test, history, opts=None) -> dict:
+    def _fallback_after_band(self, history, reason: str, blowup: bool,
+                             small_unknown: Optional[dict],
+                             band_budget: int) -> dict:
+        """The kernel can't take this history; fall back to the CPU —
+        but skip the fallback DFS when the band search already spent at
+        least what _fallback would (dedupe), and escalate to the full
+        budget when the band's size-scaled budget was smaller (a tiny
+        band budget must not replace the 5M-config fallback verdict)."""
+        needed = self.CUTOFF_MAX_CONFIGS if blowup \
+            else self.FALLBACK_MAX_CONFIGS
+        if small_unknown is not None and band_budget >= needed:
+            small_unknown["tpu-fallback-reason"] = reason
+            return small_unknown
+        return self._fallback(history, reason, blowup=blowup)
+
+    def check(self, test, history, opts=None, _band=None) -> dict:
         from ..ops import wgl
-        small, small_unknown = self._small_history_check(history)
+        small, small_unknown, band_budget = \
+            self._small_history_check(history) if _band is None else _band
         if small is not None:
             return small
         pack = self._pack_fn()
         if pack is None:
-            if small_unknown is not None:
-                small_unknown["tpu-fallback-reason"] = \
-                    "model has no kernel packing"
-                return small_unknown
-            return self._fallback(history, "model has no kernel packing")
+            return self._fallback_after_band(
+                history, "model has no kernel packing", False,
+                small_unknown, band_budget)
         p = pack(history)
         if not p.ok:
-            if small_unknown is not None:
-                # the cutoff DFS already burned the cheap-shot budget;
-                # re-running it here would duplicate that work exactly
-                small_unknown["tpu-fallback-reason"] = p.reason
-                return small_unknown
-            return self._fallback(history, p.reason, blowup=p.blowup)
+            return self._fallback_after_band(
+                history, p.reason, bool(p.blowup),
+                small_unknown, band_budget)
         # with a fallback available, defer the spill BFS until the DFS
         # has had its (cheaper) shot — see _overflow
         out = wgl.check_packed(p, f_max=self.f_max,
                                spill=not self.fallback)
-        return self._finalize(history, out, pack=p)
+        return self._finalize(history, out, pack=p,
+                              band=(None, small_unknown, band_budget))
 
     def check_batch(self, test, subhistories: dict, opts=None) -> dict:
         """Check many per-key histories in one vmapped, mesh-sharded
@@ -183,25 +233,39 @@ class TPULinearizableChecker(Checker):
         # size-cutoff first: keys whose histories the native DFS answers
         # in ms never pay packing or dispatch at all
         big_keys = []
+        bands: dict = {}
+        # the mid-size band only pays in a batch when FEW keys would
+        # actually reach the kernel launch: the launch amortizes
+        # dispatch across those keys, so a per-key serial DFS over many
+        # mid-size keys costs O(keys) against the launch's O(1) — but
+        # for a handful the DFS's near-linear witness search wins
+        mid_count = sum(1 for h in subhistories.values()
+                        if len(h) > (self.cpu_cutoff or 0))
+        batch_band = None if mid_count <= 8 else self.cpu_cutoff
         for k in subhistories:
-            small, _unknown = self._small_history_check(subhistories[k])
-            if small is not None:
-                results[k] = small
+            band = self._small_history_check(subhistories[k],
+                                             band=batch_band)
+            if band[0] is not None:
+                results[k] = band[0]
             else:
                 big_keys.append(k)
+                bands[k] = band
         if not big_keys:
             return results
         pack = self._pack_fn()
         if pack is None:
-            results.update({k: self.check(test, subhistories[k], opts)
+            results.update({k: self.check(test, subhistories[k], opts,
+                                          _band=bands[k])
                             for k in big_keys})
             return results
         packs = [pack(subhistories[k]) for k in big_keys]
         outs = wgl.check_packed_batch(packs, f_max=self.f_max)
         # unpackable keys come back "unknown" with the pack reason;
         # _finalize routes those through the CPU fallback (and top-rung
-        # overflows through the DFS-then-spill ordering)
-        results.update({k: self._finalize(subhistories[k], out, pack=p)
+        # overflows through the DFS-then-spill ordering), skipping any
+        # DFS the band already ran at sufficient budget
+        results.update({k: self._finalize(subhistories[k], out, pack=p,
+                                          band=bands[k])
                         for (k, out, p) in zip(big_keys, outs, packs)})
         return results
 

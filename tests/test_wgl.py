@@ -912,7 +912,8 @@ def test_check_batch_splits_small_and_large():
     big = History([o.evolve(index=None)
                    for o in gen_history(random.Random(101),
                                         n_procs=4, n_ops=120)])
-    checker = TPULinearizableChecker(fallback=True, cpu_cutoff=100)
+    checker = TPULinearizableChecker(fallback=True, cpu_cutoff=100,
+                                     dfs_first_max=None)
     assert len(small) <= 100 < len(big)
     outs = checker.check_batch({}, {"s": small, "b": big})
     assert outs["s"]["checker"] == "cpu-oracle"
@@ -920,3 +921,58 @@ def test_check_batch_splits_small_and_large():
     assert outs["s"]["valid?"] is True
     assert outs["b"]["valid?"] is True
     assert outs["b"]["checker"] == "tpu-wgl"
+
+
+def test_dfs_first_band_routes_midsize_histories():
+    """Histories between CPU_CUTOFF and DFS_FIRST_MAX get a scaled-
+    budget DFS first shot (measured crossover: the DFS's near-linear
+    witness search beats kernel dispatch well past 512 entries). The
+    routing assertions prove the band was taken; wall-clock is a bench
+    concern, not a unit-test one."""
+    from jepsen_etcd_tpu.checkers.tpu_linearizable import (
+        CPU_CUTOFF, DFS_FIRST_MAX)
+    rng2 = random.Random(71)
+    h = History([o.evolve(index=None)
+                 for o in gen_history(rng2, n_procs=4, n_ops=600)])
+    assert CPU_CUTOFF < len(h) <= DFS_FIRST_MAX
+    out = TPULinearizableChecker(fallback=True).check({}, h)
+    assert out["valid?"] is True
+    assert out["checker"] == "cpu-oracle"
+    assert out["engine-route"] == "size-cutoff"
+
+
+def test_dfs_first_band_invalid_stays_correct():
+    """An invalid mid-size history must produce a definitive, correct
+    verdict — a corrupted observation is provably non-linearizable and
+    neither engine may answer unknown on it."""
+    from jepsen_etcd_tpu.checkers.tpu_linearizable import (
+        CPU_CUTOFF, DFS_FIRST_MAX)
+    rng2 = random.Random(73)
+    h = History([o.evolve(index=None)
+                 for o in gen_history(rng2, n_procs=3, n_ops=400,
+                                      corrupt=True)])
+    assert CPU_CUTOFF < len(h) <= DFS_FIRST_MAX
+    ref = check_history(VersionedRegister(), h, use_native=False)
+    assert ref["valid?"] is False, "seed 73 must stay a known-bad fixture"
+    out = TPULinearizableChecker(fallback=True).check({}, h)
+    assert out["valid?"] is False
+
+
+def test_band_budget_never_replaces_full_fallback():
+    """A mid-size history the kernel can't pack must get the FULL
+    5M-config fallback search, not a tiny band-budget unknown (the
+    band budget is sized for witness-finding, not exhaustion)."""
+    checker = TPULinearizableChecker(fallback=True)
+    h = History([o.evolve(index=None)
+                 for o in gen_history(random.Random(71), n_procs=4,
+                                      n_ops=600)])
+    small, unknown, budget = checker._small_history_check(h)
+    assert small is not None and unknown is None
+    assert budget < checker.FALLBACK_MAX_CONFIGS
+    # simulate a band-budget unknown on a pack-less path: it must
+    # escalate to _fallback rather than return the band unknown
+    fake_unknown = {"valid?": "unknown", "error": "search budget exceeded"}
+    out = checker._fallback_after_band(h, "no packing", False,
+                                       fake_unknown, budget)
+    assert out["valid?"] is True          # full budget finds the witness
+    assert out["tpu-fallback-reason"] == "no packing"
