@@ -168,20 +168,25 @@ class TPULinearizableChecker(Checker):
             out2["dfs-also-unknown"] = True
         return out2
 
+    def _fallback_budget(self, blowup: bool) -> int:
+        """The ONE definition of what _fallback spends: blowup (the
+        packer proved the space astronomical) gets the cheap shot, else
+        the full budget. _fallback_after_band's verdict-reuse compare
+        must use exactly this number or its dedupe silently diverges."""
+        return self.CUTOFF_MAX_CONFIGS if blowup \
+            else self.FALLBACK_MAX_CONFIGS
+
     def _fallback(self, history, reason: str,
                   blowup: bool = False) -> dict:
         if not self.fallback:
             return {"valid?": "unknown", "reason": reason,
                     "checker": "tpu-wgl"}
         logger.debug("TPU path unavailable (%s); CPU oracle", reason)
-        # blowup (a structured flag set wherever the kernel/packer
-        # proves the space astronomical): the DFS oracle almost
-        # certainly can't finish either — give it a cheap shot (it can
-        # still find a witness for valid histories fast) instead of
-        # burning the full budget for minutes per key
-        budget = self.CUTOFF_MAX_CONFIGS if blowup \
-            else self.FALLBACK_MAX_CONFIGS
-        out = check_history(self.model_fn(), history, max_configs=budget)
+        # blowup: the DFS oracle almost certainly can't finish either —
+        # give it a cheap shot (it can still find a witness for valid
+        # histories fast) instead of burning the full budget per key
+        out = check_history(self.model_fn(), history,
+                            max_configs=self._fallback_budget(blowup))
         out["checker"] = "cpu-oracle"
         out["tpu-fallback-reason"] = reason
         return out
@@ -194,9 +199,8 @@ class TPULinearizableChecker(Checker):
         least what _fallback would (dedupe), and escalate to the full
         budget when the band's size-scaled budget was smaller (a tiny
         band budget must not replace the 5M-config fallback verdict)."""
-        needed = self.CUTOFF_MAX_CONFIGS if blowup \
-            else self.FALLBACK_MAX_CONFIGS
-        if small_unknown is not None and band_budget >= needed:
+        if small_unknown is not None and \
+                band_budget >= self._fallback_budget(blowup):
             small_unknown["tpu-fallback-reason"] = reason
             return small_unknown
         return self._fallback(history, reason, blowup=blowup)

@@ -45,6 +45,7 @@ falls back to the CPU oracle only past an explicit state budget.
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -1092,6 +1093,23 @@ def check_packed(p: Packed, f_max: Optional[int] = None,
                 "blowup": p.blowup}
     if p.R == 0:
         return {"valid?": True, "waves": 0}
+    if f_max is None and \
+            not os.environ.get("JEPSEN_ETCD_TPU_NO_PALLAS_WGL"):
+        # f_max set means an overflow-retry path chose a rung past the
+        # fused kernel's capacity 32 — launching it would only overflow
+        # again
+        # the fused Pallas wave kernel handles the common info-free
+        # W<=32 shape ~35% faster (one grid step per wave, frontier in
+        # VMEM); on capacity-32 overflow the complete jnp ladder below
+        # takes over from scratch. Real-chip only: in interpret mode
+        # (CPU CI) the fused kernel is python-slow, and its correctness
+        # is pinned directly by tests/test_wgl_pallas.py
+        import jax
+        if jax.default_backend() == "tpu":
+            from . import wgl_pallas
+            out = wgl_pallas.check_packed_pallas(p)
+            if out is not None and not out.get("overflow"):
+                return out
     # f_max (when given) is the STARTING rung; the ladder still
     # escalates past it on overflow before spilling
     if f_max is None:

@@ -1,0 +1,296 @@
+"""Fused Pallas wave kernel for the WGL frontier BFS (info-free fast path).
+
+The jnp wave loop (ops/wgl.py:_wgl_loop) costs ~100 us/wave on a v5e:
+each wave is ~a hundred small XLA ops on KB-sized tensors, so per-op
+dispatch dominates — the history check is latency-bound, not
+compute-bound. This kernel fuses one whole wave into a single Pallas
+grid step with all state resident in VMEM.
+
+The structural win that makes this simple: with NO info ops, every
+frontier state at wave k sits at depth exactly k (each successor
+advances depth by one, the initial state is depth 0). So the grid IS
+the wave counter, one row of each per-depth table streams into VMEM
+per step via BlockSpecs (double-buffered by the pipeline), and the
+frontier is a handful of (32, 128) vregs:
+
+- ``st_w``/``st_v``: window bitmask and value id per state, one state
+  per sublane row, replicated across lanes so candidate generation
+  (bit = 1 << lane) is pure elementwise math;
+- dedupe/compaction is a greedy select loop: pick any remaining valid
+  candidate, broadcast it into the next frontier row, kill its
+  duplicates — no sort, no cross-lane shuffles (frontier order is
+  irrelevant to BFS correctness);
+- acceptance, overflow, frontier size and peak live in SMEM scratch;
+  steps after termination are @pl.when-guarded no-ops.
+
+Scope (preconditions checked by ``supported``): W <= 32 window (one
+mask word), no info ops, frontier capacity 32. Overflow (more than 32
+distinct successors) bails out; the caller falls back to the complete
+jnp capacity ladder. Soundness contract is the kernel's: definitive
+answers only, never a wrong verdict — differentially fuzzed against
+the jnp kernel and both CPU oracles in tests/test_wgl_pallas.py.
+
+Reference role: this is the hot path of the Knossos-equivalent checker
+(register.clj:110-112); the reference has no analog (Knossos is a JVM
+heap search).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .wgl import (CAS, NO_ASSERT, NONE_VAL, READ, WILDCARD, WRITE,
+                  Packed, bucket, pad_tables)
+
+F = 32          # frontier capacity (sublane rows of one state block)
+LANES = 32      # lane width = window width (blocks use the exact array
+                # width, so tables ship unpadded: 4x less host prep and
+                # host->device traffic than 128-lane padding)
+CEIL_INF = 2 ** 30
+BIG = np.int32(2 ** 31 - 1)
+
+# out vector layout (SMEM (1, 8) int32)
+O_ACCEPTED, O_OVERFLOW, O_WAVES, O_PEAK, O_N = 0, 1, 2, 3, 4
+# smem scratch layout
+S_N, S_DONE, S_ACC, S_OVF, S_PEAK, S_WAVES, S_MORE, S_CNT = range(8)
+
+
+def supported(p: Packed) -> bool:
+    """This kernel's preconditions: packed OK, one mask word, no info
+    ops (the depth==wave invariant), and register-style codes."""
+    return bool(p.ok) and p.w == 32 and p.I == 0 and p.R > 0
+
+
+def _kernel(rt_ref, sok_ref, fc_ref, a1_ref, a2_ref, ver_ref, pred_ref,
+            ceil_ref, scal_ref, out_ref, st_w, st_v, val_s, nw_s, nv_s,
+            sm):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    k = pl.program_id(0)
+    R = rt_ref[0, 0]
+    # table blocks hold 8 consecutive depth rows (TPU block-shape
+    # minimum); the pipeline skips the re-fetch while k//8 is unchanged
+    sub = k % 8
+    trow = lambda ref: ref[pl.ds(sub, 1), :]        # (1,L) depth row
+
+    @pl.when(k == 0)
+    def _init():
+        st_w[:] = jnp.zeros((F, LANES), jnp.uint32)
+        st_v[:] = jnp.full((F, LANES), NONE_VAL, jnp.int32)
+        sm[S_N] = 1
+        sm[S_DONE] = jnp.where(R == 0, 1, 0)
+        sm[S_ACC] = jnp.where(R == 0, 1, 0)
+        sm[S_OVF] = 0
+        sm[S_PEAK] = 1
+        sm[S_WAVES] = 0
+
+    run = (sm[S_DONE] == 0) & (k < R)
+
+    @pl.when(run)
+    def _wave():
+        lane = jax.lax.broadcasted_iota(jnp.int32, (F, LANES), 1)
+        srow = jax.lax.broadcasted_iota(jnp.int32, (F, LANES), 0)
+        lsh = lane.astype(jnp.uint32)       # every lane is a real op slot
+
+        w = st_w[:]
+        v = st_v[:]
+        n = sm[S_N]
+        alive = srow < n
+
+        shift = scal_ref[sub, 0]
+        u_forced = scal_ref[sub, 1]
+        ceil_beyond = scal_ref[sub, 2]
+        upd = scal_ref[sub, 3]  # uint32 mask bit-identical in int32
+
+        s_ok = trow(sok_ref) != 0                   # (1,L) -> bcast
+        fc = trow(fc_ref)
+        a1 = trow(a1_ref)
+        a2 = trow(a2_ref)
+        rver = trow(ver_ref)
+        pred = trow(pred_ref).astype(jnp.uint32)
+        ceil_row = trow(ceil_ref)
+
+        not_set = ((w >> lsh) & jnp.uint32(1)) == 0
+        preds_in = (w & pred) == pred
+        version = (u_forced
+                   + lax.population_count(
+                       w & jnp.uint32(upd)).astype(jnp.int32))
+        # version-ceiling prune
+        ceil_cand = jnp.where(not_set, ceil_row, CEIL_INF)
+        min_ceil = jnp.minimum(
+            jnp.min(ceil_cand, axis=1, keepdims=True), ceil_beyond)
+        alive = alive & (version <= min_ceil)
+
+        is_read = fc == READ
+        is_write = fc == WRITE
+        is_cas = fc == CAS
+        no_assert = rver == NO_ASSERT
+        # boolean algebra, not where(): i1 selects don't lower on TPU
+        ver_ok = no_assert | (is_read & (rver == version)) | \
+            (~is_read & (rver == version + 1))
+        read_ok = is_read & ((a1 == WILDCARD) | (a1 == v))
+        model_ok = read_ok | is_write | (is_cas & (a1 == v))
+
+        bitb = jnp.uint32(1) << lsh
+        new_w_full = w | bitb
+        # slide: the `shift` lowest bits fall off and must all be set
+        ssafe = jnp.minimum(shift, 31).astype(jnp.uint32)
+        low = jnp.where(shift >= 32, jnp.uint32(0xFFFFFFFF),
+                        (jnp.uint32(1) << ssafe) - jnp.uint32(1))
+        slide_ok = (new_w_full & low) == low
+        new_w = jnp.where(shift >= 32, jnp.uint32(0),
+                          new_w_full >> ssafe)
+
+        valid = (alive & s_ok & not_set & preds_in
+                 & ver_ok & model_ok & slide_ok)
+        new_v = jnp.where(is_read, v,
+                          jnp.where(is_write, a1, a2)).astype(jnp.int32)
+
+        accepted = jnp.any(valid) & (k + 1 == R)
+
+        # greedy dedupe -> next frontier (order-free: BFS doesn't care)
+        code = srow * LANES + lane
+
+        # reductions over uint32 are unsupported in Mosaic: select in
+        # int32 bit-space and convert back
+        new_w_bits = lax.bitcast_convert_type(new_w, jnp.int32)
+
+        # statically unrolled (Mosaic won't legalize an scf.for with
+        # vreg carries), each pick @pl.when-predicated on candidates
+        # remaining: typical waves have a handful of distinct
+        # successors, so only those iterations pay the
+        # scalar-reduction chain (min + two sums + any)
+        val_s[:] = valid.astype(jnp.int32)
+        nw_s[:] = jnp.zeros((F, LANES), jnp.uint32)
+        nv_s[:] = jnp.zeros((F, LANES), jnp.int32)
+        sm[S_CNT] = 0
+        sm[S_MORE] = jnp.any(valid).astype(jnp.int32)
+        for i in range(F):
+            @pl.when(sm[S_MORE] == 1)
+            def _pick(i=i):
+                val = val_s[:] != 0
+                idx = jnp.min(jnp.where(val, code, BIG))
+                sel = code == idx
+                # int32 -> uint32 astype wraps mod 2^32: bit-identical,
+                # and scalar-legal where a scalar bitcast is not
+                w_sel = jnp.sum(jnp.where(sel, new_w_bits, 0)) \
+                    .astype(jnp.uint32)
+                v_sel = jnp.sum(jnp.where(sel, new_v, 0))
+                put = srow == i
+                nw_s[:] = jnp.where(put, w_sel, nw_s[:])
+                nv_s[:] = jnp.where(put, v_sel, nv_s[:])
+                left = val & ~((new_w == w_sel) & (new_v == v_sel))
+                val_s[:] = left.astype(jnp.int32)
+                sm[S_CNT] = sm[S_CNT] + 1
+                sm[S_MORE] = jnp.any(left).astype(jnp.int32)
+        cnt = sm[S_CNT]
+        overflow = (sm[S_MORE] == 1) & ~accepted
+
+        st_w[:] = nw_s[:]
+        st_v[:] = nv_s[:]
+        sm[S_N] = cnt
+        sm[S_WAVES] = k + 1
+        sm[S_PEAK] = jnp.maximum(sm[S_PEAK], cnt)
+        sm[S_ACC] = jnp.maximum(sm[S_ACC], accepted.astype(jnp.int32))
+        sm[S_OVF] = jnp.maximum(sm[S_OVF], overflow.astype(jnp.int32))
+        sm[S_DONE] = jnp.where(
+            accepted | overflow | (cnt == 0), 1, sm[S_DONE])
+
+    @pl.when(k == pl.num_programs(0) - 1)
+    def _emit():
+        out_ref[0, O_ACCEPTED] = sm[S_ACC]
+        out_ref[0, O_OVERFLOW] = sm[S_OVF]
+        out_ref[0, O_WAVES] = sm[S_WAVES]
+        out_ref[0, O_PEAK] = sm[S_PEAK]
+        out_ref[0, O_N] = sm[S_N]
+
+
+@functools.lru_cache(maxsize=None)
+def _call(r_pad: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    fixed = pl.BlockSpec((1, 1), lambda k: (0, 0),
+                         memory_space=pltpu.SMEM)
+    row = lambda width: pl.BlockSpec((8, width), lambda k: (k // 8, 0))
+    call = pl.pallas_call(
+        _kernel,
+        grid=(r_pad,),
+        in_specs=[
+            fixed,                                   # R_true
+            row(LANES), row(LANES), row(LANES),      # s_ok, fc, a1
+            row(LANES), row(LANES), row(LANES),      # a2, ver, pred
+            row(LANES),                              # ceil_frame
+            pl.BlockSpec((8, 4), lambda k: (k // 8, 0),
+                         memory_space=pltpu.SMEM),   # per-row scalars
+        ],
+        out_specs=pl.BlockSpec((1, 8), lambda k: (0, 0),
+                               memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 8), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((F, LANES), jnp.uint32),   # st_w
+            pltpu.VMEM((F, LANES), jnp.int32),    # st_v
+            pltpu.VMEM((F, LANES), jnp.int32),    # val_s (pick mask)
+            pltpu.VMEM((F, LANES), jnp.uint32),   # nw_s (next frontier)
+            pltpu.VMEM((F, LANES), jnp.int32),    # nv_s
+            pltpu.SMEM((8,), jnp.int32),
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )
+    return jax.jit(call)
+
+
+def check_packed_pallas(p: Packed) -> dict | None:
+    """Run the fused kernel; None when unsupported, an
+    overflow-shaped unknown when capacity 32 was exceeded (caller
+    falls back to the jnp ladder)."""
+    import jax
+    import jax.numpy as jnp
+
+    if not supported(p):
+        return None
+    r_pad = bucket(p.R)
+    t = pad_tables(p, r_pad)
+    sok = t["static_ok"].astype(np.int32)
+    fc = t["f_code"].astype(np.int32)
+    a1 = t["a1"].astype(np.int32)
+    a2 = t["a2"].astype(np.int32)
+    ver = t["ver"].astype(np.int32)
+    pred = np.ascontiguousarray(t["pred_frame"][:, :, 0]).view(np.int32)
+    ceil = t["ceil_frame"].astype(np.int32)
+    scal = np.stack([
+        t["shift"].astype(np.int32),
+        t["u_forced"].astype(np.int32),
+        t["ceil_beyond"].astype(np.int32),
+        t["upd_mask"][:, 0].view(np.int32),
+    ], axis=1)
+    rt = np.array([[p.R]], dtype=np.int32)
+
+    interpret = jax.default_backend() != "tpu"
+    out = np.asarray(_call(r_pad, interpret)(
+        jnp.asarray(rt), jnp.asarray(sok), jnp.asarray(fc),
+        jnp.asarray(a1), jnp.asarray(a2), jnp.asarray(ver),
+        jnp.asarray(pred), jnp.asarray(ceil), jnp.asarray(scal)))[0]
+    if out[O_OVERFLOW]:
+        return {"valid?": "unknown", "overflow": True,
+                "reason": "pallas frontier overflow (capacity 32)",
+                "waves": int(out[O_WAVES]),
+                "peak-frontier": int(out[O_PEAK])}
+    res = {"valid?": bool(out[O_ACCEPTED]),
+           "waves": int(out[O_WAVES]),
+           "peak-frontier": int(out[O_PEAK]),
+           "ops": int(p.R), "info-ops": 0,
+           "engine": "pallas-fused"}
+    if not res["valid?"]:
+        # match the jnp engine's invalid result shape
+        res["stuck-at-depth"] = int(out[O_WAVES])
+    return res

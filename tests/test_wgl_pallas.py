@@ -1,0 +1,102 @@
+"""Differential tests: fused Pallas wave kernel vs the jnp kernel and
+the CPU oracle. The fused kernel claims definitive answers only; every
+claim must match the reference engines (interpret mode off-TPU)."""
+
+import random
+
+import pytest
+
+from jepsen_etcd_tpu.core.op import Op
+from jepsen_etcd_tpu.core.history import History
+from jepsen_etcd_tpu.checkers import check_history
+from jepsen_etcd_tpu.models import VersionedRegister
+from jepsen_etcd_tpu.ops import wgl
+from jepsen_etcd_tpu.ops import wgl_pallas
+
+from test_wgl import gen_history
+
+
+def run_both(h):
+    p = wgl.pack_register_history(h)
+    if not p.ok or not wgl_pallas.supported(p):
+        return None
+    fused = wgl_pallas.check_packed_pallas(p)
+    ref = wgl.check_packed(p)
+    return fused, ref, p
+
+
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_differential_vs_jnp_kernel(corrupt):
+    rng = random.Random(4242 if corrupt else 77)
+    checked = 0
+    for trial in range(60):
+        h = gen_history(rng, n_procs=rng.randint(2, 5),
+                        n_ops=rng.randint(8, 40), corrupt=corrupt)
+        got = run_both(h)
+        if got is None:
+            continue
+        fused, ref, p = got
+        if fused["valid?"] == "unknown" or ref["valid?"] == "unknown":
+            continue
+        checked += 1
+        assert fused["valid?"] == ref["valid?"], (
+            f"trial {trial}: fused={fused} ref={ref['valid?']}\n"
+            + h.to_jsonl())
+        # same number of waves to a verdict on valid histories
+        if ref["valid?"] is True:
+            assert fused["waves"] == ref.get("waves"), (fused, ref)
+    assert checked >= 40, f"only {checked}/60 comparable"
+
+
+def test_differential_vs_cpu_oracle():
+    rng = random.Random(9)
+    for trial in range(30):
+        h = gen_history(rng, n_procs=3, n_ops=24,
+                        corrupt=(trial % 2 == 1))
+        got = run_both(h)
+        if got is None:
+            continue
+        fused, _, _ = got
+        if fused["valid?"] == "unknown":
+            continue
+        cpu = check_history(VersionedRegister(), h, use_native=False)
+        assert fused["valid?"] == cpu["valid?"], (
+            f"trial {trial}: fused={fused} cpu={cpu['valid?']}\n"
+            + h.to_jsonl())
+
+
+def test_known_good_and_bad_fixtures():
+    good = History([
+        Op(type="invoke", process=0, f="write", value=[None, 1]),
+        Op(type="ok", process=0, f="write", value=[1, 1]),
+        Op(type="invoke", process=1, f="read", value=[None, None]),
+        Op(type="ok", process=1, f="read", value=[1, 1]),
+    ])
+    p = wgl.pack_register_history(good)
+    out = wgl_pallas.check_packed_pallas(p)
+    assert out["valid?"] is True and out["engine"] == "pallas-fused"
+    assert out["waves"] == p.R
+
+    bad = History([
+        Op(type="invoke", process=0, f="write", value=[None, 1]),
+        Op(type="ok", process=0, f="write", value=[1, 1]),
+        Op(type="invoke", process=1, f="read", value=[None, None]),
+        Op(type="ok", process=1, f="read", value=[1, 2]),  # never written
+    ])
+    p = wgl.pack_register_history(bad)
+    out = wgl_pallas.check_packed_pallas(p)
+    assert out["valid?"] is False
+
+
+def test_unsupported_shapes_return_none():
+    # info ops break the depth==wave invariant
+    h = History([
+        Op(type="invoke", process=0, f="write", value=[None, 7]),
+        Op(type="info", process=0, f="write", value=[None, 7],
+           error="timeout"),
+        Op(type="invoke", process=1, f="read", value=[None, None]),
+        Op(type="ok", process=1, f="read", value=[1, 7]),
+    ])
+    p = wgl.pack_register_history(h)
+    assert p.ok and p.I == 1
+    assert wgl_pallas.check_packed_pallas(p) is None
