@@ -1099,11 +1099,12 @@ def check_packed(p: Packed, f_max: Optional[int] = None,
         # fused kernel's capacity 32 — launching it would only overflow
         # again
         # the fused Pallas wave kernel handles the common info-free
-        # W<=32 shape ~35% faster (one grid step per wave, frontier in
-        # VMEM); on capacity-32 overflow the complete jnp ladder below
-        # takes over from scratch. Real-chip only: in interpret mode
-        # (CPU CI) the fused kernel is python-slow, and its correctness
-        # is pinned directly by tests/test_wgl_pallas.py
+        # W<=32 shape 2-4x faster (one grid step per wave, frontier in
+        # VMEM; 10k-op check 1.2s -> ~0.4s); on capacity-32 overflow
+        # the complete jnp ladder below takes over from scratch.
+        # Real-chip only: in interpret mode (CPU CI) the fused kernel
+        # is python-slow, and its correctness is pinned directly by
+        # tests/test_wgl_pallas.py
         import jax
         if jax.default_backend() == "tpu":
             from . import wgl_pallas
