@@ -45,11 +45,18 @@ falls back to the CPU oracle only past an explicit state budget.
 from __future__ import annotations
 
 import functools
+import logging
 import os
 from dataclasses import dataclass
 from typing import Any, Optional
 
 import numpy as np
+
+logger = logging.getLogger("jepsen_etcd_tpu.ops")
+
+#: set after the fused Pallas kernel fails once: a broken toolchain
+#: disables the fast path for the rest of the process
+_pallas_broken = [False]
 
 from ..checkers.linearizable import Entry, history_entries
 from .common import UnsupportedValue, ValueIds, as_version
@@ -1106,9 +1113,19 @@ def check_packed(p: Packed, f_max: Optional[int] = None,
         # is python-slow, and its correctness is pinned directly by
         # tests/test_wgl_pallas.py
         import jax
-        if jax.default_backend() == "tpu":
+        if jax.default_backend() == "tpu" and not _pallas_broken[0]:
             from . import wgl_pallas
-            out = wgl_pallas.check_packed_pallas(p)
+            try:
+                out = wgl_pallas.check_packed_pallas(p)
+            except Exception as e:
+                # a Mosaic/compile failure must degrade to the jnp
+                # ladder, not crash the checker — and a systematically
+                # broken toolchain must not re-pay a failed compile
+                # (and a warning line) per history
+                logger.warning("fused wave kernel unavailable (%r); "
+                               "disabling it for this process", e)
+                _pallas_broken[0] = True
+                out = None
             if out is not None and not out.get("overflow"):
                 return out
     # f_max (when given) is the STARTING rung; the ladder still
