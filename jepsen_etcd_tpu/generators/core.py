@@ -41,6 +41,23 @@ from typing import Any, Callable, Iterable, Optional
 from ..core.op import Op, NEMESIS
 
 PENDING = "pending"
+_arity_cache: dict = {}  # FnGen: f -> parameter count (inspect is hot-loop cost)
+
+
+class _WorkersMap(dict):
+    """Context.workers carrier with a memo of thread-subset dicts.
+
+    The interpreter reuses one snapshot across polls until workers actually
+    change, so restrict() (called per combinator level per poll — HOT LOOP
+    #1, SURVEY §3.5) can reuse the subset dicts too.  Snapshots are replaced,
+    never mutated, so sharing is safe.
+    """
+
+    __slots__ = ("sub_cache",)
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.sub_cache: dict = {}
 SECOND = 1_000_000_000
 
 
@@ -64,11 +81,16 @@ class Context:
     concurrency: int
 
     def restrict(self, threads: frozenset) -> "Context":
-        return replace(
-            self,
-            free=self.free & threads,
-            workers={t: p for t, p in self.workers.items() if t in threads},
-        )
+        w = self.workers
+        cache = getattr(w, "sub_cache", None)
+        sub = cache.get(threads) if cache is not None else None
+        if sub is None:
+            sub = _WorkersMap((t, p) for t, p in w.items() if t in threads)
+            if cache is not None:
+                cache[threads] = sub
+        return Context(time=self.time, free=self.free & threads,
+                       workers=sub, rng=self.rng,
+                       concurrency=self.concurrency)
 
     @property
     def client_threads(self) -> list:
@@ -162,10 +184,13 @@ class FnGen(Generator):
     f: Callable
 
     def _call(self, test, ctx):
-        try:
-            nparams = len(inspect.signature(self.f).parameters)
-        except (TypeError, ValueError):
-            nparams = 2
+        nparams = _arity_cache.get(self.f)
+        if nparams is None:
+            try:
+                nparams = len(inspect.signature(self.f).parameters)
+            except (TypeError, ValueError):
+                nparams = 2
+            _arity_cache[self.f] = nparams
         if nparams == 0:
             return self.f()
         if nparams == 1:
@@ -217,19 +242,22 @@ class Seq(Generator):
                 return None
             res = head.op(test, ctx)
             if res is None:
-                me = replace(me, idx=me.idx + 1, current=None)
+                me = Seq(me.items, me.idx + 1, me.it, None)
                 continue
             if res[0] == PENDING:
                 _, wake, head2 = res
-                return (PENDING, wake, replace(me, current=head2))
+                return (PENDING, wake, Seq(me.items, me.idx, me.it, head2))
             op, head2 = res
-            return (op, replace(me, current=head2))
+            return (op, Seq(me.items, me.idx, me.it, head2))
 
     def update(self, test, ctx, event):
         head = self._head()
         if head is None:
             return self
-        return replace(self, current=head.update(test, ctx, event))
+        h2 = head.update(test, ctx, event)
+        if h2 is self.current:
+            return self
+        return Seq(self.items, self.idx, self.it, h2)
 
 
 class _NoneGen(Generator):
@@ -277,8 +305,11 @@ class Mix(Generator):
                 Mix(tuple(new)))
 
     def update(self, test, ctx, event):
-        return Mix(tuple(g.update(test, ctx, event) if g else None
-                         for g in self.gens))
+        new = tuple(g.update(test, ctx, event) if g else None
+                    for g in self.gens)
+        if all(a is b for a, b in zip(new, self.gens)):
+            return self
+        return Mix(new)
 
 
 def _min_wake(a, b):
@@ -309,8 +340,10 @@ class Limit(Generator):
         return (op, Limit(self.n - 1, g2))
 
     def update(self, test, ctx, event):
-        return Limit(self.n, self.gen.update(test, ctx, event)
-                     if self.gen else None)
+        if self.gen is None:
+            return self
+        g2 = self.gen.update(test, ctx, event)
+        return self if g2 is self.gen else Limit(self.n, g2)
 
 
 @dataclass(frozen=True)
@@ -333,17 +366,20 @@ class Stagger(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
-            return (PENDING, wake, replace(self, gen=g2))
+            return (PENDING, wake, Stagger(self.dt, g2, self.next_time))
         op, g2 = res
         nt = self.next_time if self.next_time is not None else ctx.time
         t_emit = max(op["time"], nt)
         op["time"] = t_emit
         gap = int(ctx.rng.random() * 2 * self.dt)
-        return (op, replace(self, gen=g2, next_time=t_emit + gap))
+        return (op, Stagger(self.dt, g2, t_emit + gap))
 
     def update(self, test, ctx, event):
-        return replace(self, gen=self.gen.update(test, ctx, event)
-                       if self.gen else None)
+        if self.gen is None:
+            return self
+        g2 = self.gen.update(test, ctx, event)
+        return self if g2 is self.gen else Stagger(self.dt, g2,
+                                                   self.next_time)
 
 
 @dataclass(frozen=True)
@@ -362,16 +398,19 @@ class Delay(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
-            return (PENDING, wake, replace(self, gen=g2))
+            return (PENDING, wake, Delay(self.dt, g2, self.next_time))
         op, g2 = res
         nt = self.next_time if self.next_time is not None else ctx.time
         t_emit = max(op["time"], nt)
         op["time"] = t_emit
-        return (op, replace(self, gen=g2, next_time=t_emit + self.dt))
+        return (op, Delay(self.dt, g2, t_emit + self.dt))
 
     def update(self, test, ctx, event):
-        return replace(self, gen=self.gen.update(test, ctx, event)
-                       if self.gen else None)
+        if self.gen is None:
+            return self
+        g2 = self.gen.update(test, ctx, event)
+        return self if g2 is self.gen else Delay(self.dt, g2,
+                                                 self.next_time)
 
 
 @dataclass(frozen=True)
@@ -398,7 +437,6 @@ class TimeLimit(Generator):
 
     def op(self, test, ctx):
         dl = self.deadline if self.deadline is not None else ctx.time + self.t
-        me = replace(self, deadline=dl)
         if ctx.time >= dl or self.gen is None:
             return None
         res = self.gen.op(test, ctx)
@@ -406,16 +444,19 @@ class TimeLimit(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
-            return (PENDING, _min_wake(wake, dl), replace(me, gen=g2))
+            return (PENDING, _min_wake(wake, dl), TimeLimit(self.t, g2, dl))
         op, g2 = res
         if op["time"] >= dl:
             # Op would fire past the deadline: the limit cuts it off.
             return None
-        return (op, replace(me, gen=g2))
+        return (op, TimeLimit(self.t, g2, dl))
 
     def update(self, test, ctx, event):
-        return replace(self, gen=self.gen.update(test, ctx, event)
-                       if self.gen else None)
+        if self.gen is None:
+            return self
+        g2 = self.gen.update(test, ctx, event)
+        return self if g2 is self.gen else TimeLimit(self.t, g2,
+                                                     self.deadline)
 
 
 @dataclass(frozen=True)
@@ -430,19 +471,20 @@ class Synchronize(Generator):
             return None
         if not self.started and not ctx.all_free:
             return (PENDING, None, self)
-        me = replace(self, started=True)
         res = self.gen.op(test, ctx)
         if res is None:
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
-            return (PENDING, wake, replace(me, gen=g2))
+            return (PENDING, wake, Synchronize(g2, True))
         op, g2 = res
-        return (op, replace(me, gen=g2))
+        return (op, Synchronize(g2, True))
 
     def update(self, test, ctx, event):
-        return replace(self, gen=self.gen.update(test, ctx, event)
-                       if self.gen else None)
+        if self.gen is None:
+            return self
+        g2 = self.gen.update(test, ctx, event)
+        return self if g2 is self.gen else Synchronize(g2, self.started)
 
 
 @dataclass(frozen=True)
@@ -472,17 +514,17 @@ class OnThreads(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
-            return (PENDING, wake, replace(self, gen=g2))
+            return (PENDING, wake, OnThreads(self.threads, g2))
         op, g2 = res
-        return (op, replace(self, gen=g2))
+        return (op, OnThreads(self.threads, g2))
 
     def update(self, test, ctx, event):
         if self.gen is None:
             return self
         t = ctx.thread_of(event.get("process"))
         if t in self.threads:
-            return replace(self, gen=self.gen.update(
-                test, ctx.restrict(self.threads), event))
+            g2 = self.gen.update(test, ctx.restrict(self.threads), event)
+            return self if g2 is self.gen else OnThreads(self.threads, g2)
         return self
 
 
@@ -524,7 +566,10 @@ class Alt(Generator):
                 Alt(tuple(new)))
 
     def update(self, test, ctx, event):
-        return Alt(tuple(b.update(test, ctx, event) for b in self.branches))
+        new = tuple(b.update(test, ctx, event) for b in self.branches)
+        if all(a is b for a, b in zip(new, self.branches)):
+            return self
+        return Alt(new)
 
 
 @dataclass(frozen=True)
@@ -589,6 +634,8 @@ class EachThread(Generator):
             (t, g.update(test, ctx.restrict(frozenset([t])), event)
              if (g is not None and t == t_ev) else g)
             for t, g in self.children)
+        if all(a[1] is b[1] for a, b in zip(new, self.children)):
+            return self
         return replace(self, children=new)
 
 
@@ -607,13 +654,15 @@ class FMap(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
-            return (PENDING, wake, replace(self, gen=g2))
+            return (PENDING, wake, FMap(self.f, g2))
         op, g2 = res
-        return (self.f(op), replace(self, gen=g2))
+        return (self.f(op), FMap(self.f, g2))
 
     def update(self, test, ctx, event):
-        return replace(self, gen=self.gen.update(test, ctx, event)
-                       if self.gen else None)
+        if self.gen is None:
+            return self
+        g2 = self.gen.update(test, ctx, event)
+        return self if g2 is self.gen else FMap(self.f, g2)
 
 
 @dataclass(frozen=True)
@@ -645,7 +694,8 @@ class Cycle(Generator):
     def update(self, test, ctx, event):
         if self.current is None:
             return self
-        return replace(self, current=self.current.update(test, ctx, event))
+        g2 = self.current.update(test, ctx, event)
+        return self if g2 is self.current else replace(self, current=g2)
 
 
 # ---------------------------------------------------------------------------
@@ -735,15 +785,15 @@ class _ClientsOnly(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
-            return (PENDING, wake, replace(self, gen=g2))
+            return (PENDING, wake, _ClientsOnly(g2))
         op, g2 = res
-        return (op, replace(self, gen=g2))
+        return (op, _ClientsOnly(g2))
 
     def update(self, test, ctx, event):
         if self.gen is None or not isinstance(event.get("process"), int):
             return self
-        return replace(self, gen=self.gen.update(
-            test, self._restricted(ctx), event))
+        g2 = self.gen.update(test, self._restricted(ctx), event)
+        return self if g2 is self.gen else _ClientsOnly(g2)
 
 
 def clients(client_gen, nemesis_gen=None) -> Generator:
@@ -805,13 +855,15 @@ class Reserve(Generator):
             return None
         if res[0] == PENDING:
             _, wake, alt2 = res
-            return (PENDING, wake, replace(me, resolved=alt2))
+            return (PENDING, wake, Reserve(me.counts, me.gens, alt2))
         op, alt2 = res
-        return (op, replace(me, resolved=alt2))
+        return (op, Reserve(me.counts, me.gens, alt2))
 
     def update(self, test, ctx, event):
         me = self._resolve(ctx)
-        return replace(me, resolved=me.resolved.update(test, ctx, event))
+        alt2 = me.resolved.update(test, ctx, event)
+        return me if alt2 is me.resolved else Reserve(me.counts, me.gens,
+                                                      alt2)
 
 
 def reserve(*args) -> Generator:

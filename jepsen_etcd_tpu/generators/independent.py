@@ -152,12 +152,13 @@ class ConcurrentGenerator(Generator):
         if not isinstance(p, int):
             return self
         t = ctx.thread_of(p)
-        gs = list(self.groups)
-        for i, (threads, key, g) in enumerate(gs):
+        for i, (threads, key, g) in enumerate(self.groups):
             if g is not None and t in threads:
-                gs[i] = (threads, key,
-                         g.update(test, ctx.restrict(threads),
-                                  untuple(event)))
+                g2 = g.update(test, ctx.restrict(threads), untuple(event))
+                if g2 is g:
+                    return self
+                gs = list(self.groups)
+                gs[i] = (threads, key, g2)
                 return replace(self, groups=tuple(gs))
         return self
 

@@ -24,7 +24,7 @@ from typing import Any, Callable, Optional
 
 from ..core.op import Op, NEMESIS, INFO
 from ..core.history import History
-from ..generators.core import Context, ensure_gen, PENDING
+from ..generators.core import Context, ensure_gen, PENDING, _WorkersMap
 from .sim import SimLoop, Queue, current_loop, sleep, wait_for
 
 import logging
@@ -63,9 +63,19 @@ async def interpret(
             on_op(op)
         return op
 
+    # Snapshots shared across polls until the underlying sets mutate: ctx()
+    # runs several times per op, and restrict() memoizes subset dicts on the
+    # workers snapshot (see generators.core._WorkersMap).  Snapshots are
+    # replaced on change, never mutated, so handing them out is safe.
+    snap: dict = {"workers": None, "free": None}
+
     def ctx() -> Context:
-        return Context(time=loop.now, free=frozenset(free),
-                       workers=dict(workers), rng=loop.rng,
+        if snap["workers"] is None:
+            snap["workers"] = _WorkersMap(workers)
+        if snap["free"] is None:
+            snap["free"] = frozenset(free)
+        return Context(time=loop.now, free=snap["free"],
+                       workers=snap["workers"], rng=loop.rng,
                        concurrency=concurrency)
 
     async def worker(thread: Any) -> None:
@@ -92,6 +102,7 @@ async def interpret(
             # only after we've already picked up the next op).
             if done.get("type") == INFO and isinstance(thread, int):
                 workers[thread] = workers[thread] + concurrency
+                snap["workers"] = None
             events.put(("complete", thread, done))
 
     tasks = [loop.spawn(worker(t), name=f"worker-{t}") for t in threads]
@@ -103,6 +114,7 @@ async def interpret(
             outstanding[thread] -= 1
             if outstanding[thread] == 0:
                 free.add(thread)
+                snap["free"] = None
         if gen is not None:
             gen = gen.update(test, ctx(), op)
 
@@ -149,7 +161,9 @@ async def interpret(
         # must not be dropped: enqueue even onto a busy thread (the worker
         # drains its inbox sequentially); `free` stays false until the
         # inbox is empty again (see handle()).
-        free.discard(thread)
+        if thread in free:
+            free.discard(thread)
+            snap["free"] = None
         outstanding[thread] += 1
         inboxes[thread].put(op)
 

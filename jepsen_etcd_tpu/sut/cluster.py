@@ -122,6 +122,7 @@ class Node:
         self.membership: list[str] = list(membership)
         self.leases: dict[int, int] = {}     # lease id -> ttl (applied state)
         # leader volatile
+        self.send_inflight: set = set()  # peers with a sleeping _send_append
         self.next_index: dict[str, int] = {}
         self.match_index: dict[str, int] = {}
         self.lease_expiry: dict[int, int] = {}
@@ -225,7 +226,7 @@ class Node:
                 list(self.membership), dict(self.leases))
         self.snap_current = walmod.encode_records([snap])
         # drop the log prefix; rebuild the WAL from the snapshot point
-        keep = [e for e in self.log if e.index > applied]
+        keep = self.log[max(0, applied + 1 - self.log_start):]
         self.log = keep
         self.log_start = applied + 1
         self.wal_current = walmod.encode_records(
@@ -551,8 +552,11 @@ class Cluster:
 
     def _replicate_now(self, leader: Node) -> None:
         for m in leader.membership:
-            if m == leader.name:
+            if m == leader.name or m in leader.send_inflight:
+                # a sender is already sleeping its repl_delay; it reads the
+                # log at wake time, so it will carry entries appended now
                 continue
+            leader.send_inflight.add(m)
             self.loop.spawn(self._send_append(leader, m), "repl")
         self._advance_commit(leader)
 
@@ -574,6 +578,9 @@ class Cluster:
 
     async def _send_append(self, leader: Node, peer_name: str) -> None:
         await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+        # past the coalescing window: appends after this point need (and
+        # will get) a fresh sender
+        leader.send_inflight.discard(peer_name)
         peer = self.nodes.get(peer_name)
         if (peer is None or leader.role != "leader" or not leader.alive
                 or not self.reachable(leader.name, peer_name)
@@ -618,8 +625,9 @@ class Cluster:
         if not ok:
             leader.next_index[peer_name] = max(1, ni - 1)
             return
-        # append entries from ni
-        entries = [e for e in leader.log if e.index >= ni]
+        # append entries from ni (log is contiguous from log_start, so the
+        # tail is a slice — a full-log scan here is O(ops^2) over a run)
+        entries = leader.log[max(0, ni - leader.log_start):]
         if entries:
             # truncate conflicts
             first = entries[0].index
