@@ -57,6 +57,24 @@ logger = logging.getLogger("jepsen_etcd_tpu.ops")
 #: set after the fused Pallas kernel fails once: a broken toolchain
 #: disables the fast path for the rest of the process
 _pallas_broken = [False]
+_mxu_broken = [False]
+
+
+def _run_fused(broken: list, name: str, call):
+    """Shared fused-kernel dispatch guard: TPU-backend + kill-switch
+    env + broken-flag checks, and degrade-don't-crash on Mosaic
+    failures (disabling the engine for the process)."""
+    import jax
+    if jax.default_backend() != "tpu" or broken[0] or \
+            os.environ.get("JEPSEN_ETCD_TPU_NO_PALLAS_WGL"):
+        return None
+    try:
+        return call()
+    except Exception as e:  # a compile failure must degrade, not crash
+        logger.warning("%s kernel unavailable (%r); disabling it for "
+                       "this process", name, e)
+        broken[0] = True
+        return None
 
 from ..checkers.linearizable import Entry, history_entries
 from .common import UnsupportedValue, ValueIds, as_version
@@ -164,6 +182,18 @@ class Packed:
     c_mask: Any = None        # [C] uint32 (count field mask)
     i_static_ok: Any = None   # [R, I] bool, class-major member order
     ipred_frame: Any = None   # [R, I, NW] uint32, class-major member order
+    # per-op vectors (the compact source the [R, W] frames are gathered
+    # from) — retained so device-side frame builders (ops/wgl_mxu.py)
+    # can ship ~32 B/op instead of ~512 B/op over the host->device link
+    op_a1: Any = None         # [R] int32 (raw, WILDCARD = -1)
+    op_a2: Any = None         # [R] int32
+    op_ver: Any = None        # [R] int32 (NO_ASSERT sentinel)
+    op_f: Any = None          # [R] int8
+    op_pred_rank: Any = None  # [R] int32 (# required preds by ret<inv)
+    op_ceiling: Any = None    # [R] int32 (version ceiling, INF 2**30)
+    inv_rank: Any = None      # [R] int32 (invoke-time rank)
+    ret_rank: Any = None      # [R] int32 (return-time rank)
+    lo: Any = None            # [R+1] int64 (window base per depth)
 
 
 MUTEX_LOCKED = "locked"
@@ -458,6 +488,14 @@ def _pack_register_history(history, adapter) -> Packed:
         ipred_frame = np.zeros((R, 0, nw), dtype=np.uint32)
         i_static_ok = np.zeros((R, 0), dtype=bool)
 
+    # rank-compress the int64 invoke/return times jointly: pairwise
+    # comparisons (all the frames need) are order-preserved, and ranks
+    # fit int32 for device-side frame building
+    all_times = np.concatenate([inv, ret])
+    order = np.argsort(all_times, kind="stable")
+    ranks = np.empty(2 * R, dtype=np.int32)
+    ranks[order] = np.arange(2 * R, dtype=np.int32)
+
     return Packed(
         ok=True, R=R, I=I, n_values=len(vids.rev), w=w,
         shift=(lo[1:] - lo[:-1]).astype(np.int32),
@@ -469,6 +507,9 @@ def _pack_register_history(history, adapter) -> Packed:
         C=C, ni=ni, c_f=c_f, c_a1=c_a1, c_a2=c_a2, c_size=c_size,
         c_off=c_off, c_word=c_word, c_shift=c_shift, c_mask=c_mask,
         i_static_ok=i_static_ok, ipred_frame=ipred_frame,
+        op_a1=a1, op_a2=a2, op_ver=ver, op_f=f,
+        op_pred_rank=pred.astype(np.int32), op_ceiling=ceiling,
+        inv_rank=ranks[:R], ret_rank=ranks[R:], lo=lo,
     )
 
 
@@ -998,8 +1039,26 @@ def check_packed_batch(packs: list, f_max: Optional[int] = None) -> list:
     Returns one result dict per pack, aligned with the input order.
     """
     results: list = [None] * len(packs)
+    # MXU wave kernel first: ONE pallas dispatch per R-bucket for every
+    # supported key (the tunnel round trip is the dominant device cost,
+    # so a single launch for the whole batch is the only device path
+    # that competes with the in-process native sweep). Unsupported or
+    # overflowing keys fall through to the vmapped jnp path / ladder.
+    # f_max set means the caller chose a rung past the fused capacity
+    # 32 — the kernel would only overflow again (same guard as
+    # check_packed's single-history path).
+    if f_max is None:
+        from . import wgl_mxu
+        mxu_out = _run_fused(_mxu_broken, "mxu batch",
+                             lambda: wgl_mxu.check_packed_batch_mxu(packs))
+        if mxu_out is not None:
+            for i, out in enumerate(mxu_out):
+                if out is not None and not out.get("overflow"):
+                    results[i] = out
     groups: dict = {}
     for i, p in enumerate(packs):
+        if results[i] is not None:
+            continue
         if not p.ok:
             results[i] = {"valid?": "unknown", "reason": p.reason,
                           "blowup": p.blowup}
@@ -1103,31 +1162,27 @@ def check_packed(p: Packed, f_max: Optional[int] = None,
     if f_max is None and \
             not os.environ.get("JEPSEN_ETCD_TPU_NO_PALLAS_WGL"):
         # f_max set means an overflow-retry path chose a rung past the
-        # fused kernel's capacity 32 — launching it would only overflow
-        # again
-        # the fused Pallas wave kernel handles the common info-free
-        # W<=32 shape 2-4x faster (one grid step per wave, frontier in
-        # VMEM; 10k-op check 1.2s -> ~0.4s); on capacity-32 overflow
-        # the complete jnp ladder below takes over from scratch.
-        # Real-chip only: in interpret mode (CPU CI) the fused kernel
-        # is python-slow, and its correctness is pinned directly by
-        # tests/test_wgl_pallas.py
-        import jax
-        if jax.default_backend() == "tpu" and not _pallas_broken[0]:
-            from . import wgl_pallas
-            try:
-                out = wgl_pallas.check_packed_pallas(p)
-            except Exception as e:
-                # a Mosaic/compile failure must degrade to the jnp
-                # ladder, not crash the checker — and a systematically
-                # broken toolchain must not re-pay a failed compile
-                # (and a warning line) per history
-                logger.warning("fused wave kernel unavailable (%r); "
-                               "disabling it for this process", e)
-                _pallas_broken[0] = True
-                out = None
-            if out is not None and not out.get("overflow"):
-                return out
+        # fused kernels' capacity 32 — launching them would only
+        # overflow again.
+        # Engine order on real TPU: the MXU wave kernel (ops/wgl_mxu.py
+        # — one table stream, matmul compaction, ~6x the r3 fused
+        # kernel end-to-end at 50k scale), then the r3 pick-loop kernel
+        # for shapes the MXU one doesn't take, then the complete jnp
+        # ladder. A Mosaic failure in either kernel degrades to the
+        # next engine and disables that kernel for the process.
+        # Real-chip only: in interpret mode (CPU CI) the fused kernels
+        # are python-slow, and their correctness is pinned directly by
+        # tests/test_wgl_mxu.py and tests/test_wgl_pallas.py
+        from . import wgl_mxu
+        out = _run_fused(_mxu_broken, "mxu wave",
+                         lambda: wgl_mxu.check_packed_mxu(p))
+        if out is not None and not out.get("overflow"):
+            return out
+        from . import wgl_pallas
+        out = _run_fused(_pallas_broken, "fused wave",
+                         lambda: wgl_pallas.check_packed_pallas(p))
+        if out is not None and not out.get("overflow"):
+            return out
     # f_max (when given) is the STARTING rung; the ladder still
     # escalates past it on overflow before spilling
     if f_max is None:

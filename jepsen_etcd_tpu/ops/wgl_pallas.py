@@ -45,6 +45,7 @@ from .wgl import (CAS, NO_ASSERT, NONE_VAL, READ, WILDCARD, WRITE,
                   Packed, bucket, pad_tables)
 
 F = 32          # frontier capacity (sublane rows of one state block)
+PICK_CHUNK = 4  # branchless picks per scalar-guarded chunk
 LANES = 32      # lane width = window width (blocks use the exact array
                 # width, so tables ship unpadded: 4x less host prep and
                 # host->device traffic than 128-lane padding)
@@ -161,33 +162,46 @@ def _kernel(rt_ref, sok_ref, fc_ref, a1_ref, a2_ref, ver_ref, pred_ref,
         new_w_bits = lax.bitcast_convert_type(new_w, jnp.int32)
 
         # statically unrolled (Mosaic won't legalize an scf.for with
-        # vreg carries), each pick @pl.when-predicated on candidates
-        # remaining: typical waves have a handful of distinct
-        # successors, so only those iterations pay the
-        # scalar-reduction chain (min + two sums + any)
+        # vreg carries) in chunks of PICK_CHUNK branchless picks: the
+        # old one-@pl.when-per-pick form paid a vector->scalar sync
+        # (any() -> SMEM -> scf.if) per pick, ~3/4 of the wave cost.
+        # Within a chunk everything stays in vregs — an exhausted pick
+        # selects nothing (idx == BIG -> put mask empty) and is a cheap
+        # vector no-op; the per-chunk guard still skips the tail, so
+        # typical waves (a handful of distinct successors) run one
+        # chunk and two scalar syncs total.
         val_s[:] = valid.astype(jnp.int32)
         nw_s[:] = jnp.zeros((F, LANES), jnp.uint32)
         nv_s[:] = jnp.zeros((F, LANES), jnp.int32)
         sm[S_CNT] = 0
         sm[S_MORE] = jnp.any(valid).astype(jnp.int32)
-        for i in range(F):
+        for c in range(0, F, PICK_CHUNK):
             @pl.when(sm[S_MORE] == 1)
-            def _pick(i=i):
+            def _chunk(c=c):
                 val = val_s[:] != 0
-                idx = jnp.min(jnp.where(val, code, BIG))
-                sel = code == idx
-                # int32 -> uint32 astype wraps mod 2^32: bit-identical,
-                # and scalar-legal where a scalar bitcast is not
-                w_sel = jnp.sum(jnp.where(sel, new_w_bits, 0)) \
-                    .astype(jnp.uint32)
-                v_sel = jnp.sum(jnp.where(sel, new_v, 0))
-                put = srow == i
-                nw_s[:] = jnp.where(put, w_sel, nw_s[:])
-                nv_s[:] = jnp.where(put, v_sel, nv_s[:])
-                left = val & ~((new_w == w_sel) & (new_v == v_sel))
-                val_s[:] = left.astype(jnp.int32)
-                sm[S_CNT] = sm[S_CNT] + 1
-                sm[S_MORE] = jnp.any(left).astype(jnp.int32)
+                nw_c = nw_s[:]
+                nv_c = nv_s[:]
+                cnt = jnp.int32(0)
+                for i in range(c, c + PICK_CHUNK):
+                    idx = jnp.min(jnp.where(val, code, BIG))
+                    sel = code == idx
+                    # int32 -> uint32 astype wraps mod 2^32:
+                    # bit-identical, and scalar-legal where a scalar
+                    # bitcast is not
+                    w_sel = jnp.sum(jnp.where(sel, new_w_bits, 0)) \
+                        .astype(jnp.uint32)
+                    v_sel = jnp.sum(jnp.where(sel, new_v, 0))
+                    has = idx < BIG
+                    put = (srow == i) & has
+                    nw_c = jnp.where(put, w_sel, nw_c)
+                    nv_c = jnp.where(put, v_sel, nv_c)
+                    cnt = cnt + has.astype(jnp.int32)
+                    val = val & ~((new_w == w_sel) & (new_v == v_sel))
+                nw_s[:] = nw_c
+                nv_s[:] = nv_c
+                val_s[:] = val.astype(jnp.int32)
+                sm[S_CNT] = sm[S_CNT] + cnt
+                sm[S_MORE] = jnp.any(val).astype(jnp.int32)
         cnt = sm[S_CNT]
         overflow = (sm[S_MORE] == 1) & ~accepted
 

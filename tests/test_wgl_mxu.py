@@ -1,0 +1,141 @@
+"""Differential tests: MXU-compacted wave kernel vs the jnp kernel and
+the CPU oracle (ops/wgl_mxu.py). The kernel claims definitive answers
+only; every claim must match the reference engines. Off-TPU these run
+the kernel in pallas interpret mode — same semantics (the packed
+(8,128) planes are dense, so reshape views agree between interpret and
+Mosaic layouts)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_etcd_tpu.checkers import check_history
+from jepsen_etcd_tpu.models import VersionedRegister
+from jepsen_etcd_tpu.ops import wgl
+from jepsen_etcd_tpu.ops import wgl_mxu
+
+from test_wgl import gen_history
+
+
+def run_both(h):
+    p = wgl.pack_register_history(h)
+    if not p.ok or not wgl_mxu.supported(p):
+        return None
+    got = wgl_mxu.check_packed_mxu(p)
+    ref = wgl.check_packed(p)
+    return got, ref, p
+
+
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_differential_vs_jnp_kernel(corrupt):
+    rng = random.Random(4242 if corrupt else 77)
+    checked = 0
+    for trial in range(60):
+        h = gen_history(rng, n_procs=rng.randint(2, 5),
+                        n_ops=rng.randint(8, 40), corrupt=corrupt)
+        got = run_both(h)
+        if got is None:
+            continue
+        mxu, ref, p = got
+        if mxu["valid?"] == "unknown" or ref["valid?"] == "unknown":
+            continue
+        checked += 1
+        assert mxu["valid?"] == ref["valid?"], (
+            f"trial {trial}: mxu={mxu} ref={ref['valid?']}\n"
+            + h.to_jsonl())
+    assert checked >= 40, f"only {checked}/60 comparable"
+
+
+def test_differential_vs_cpu_oracle():
+    rng = random.Random(9)
+    for trial in range(30):
+        h = gen_history(rng, n_procs=3, n_ops=24,
+                        corrupt=(trial % 2 == 1))
+        got = run_both(h)
+        if got is None:
+            continue
+        mxu, _, _ = got
+        if mxu["valid?"] == "unknown":
+            continue
+        cpu = check_history(VersionedRegister(), h)
+        assert mxu["valid?"] == cpu["valid?"], (mxu, cpu, h.to_jsonl())
+
+
+def test_device_table_builder_matches_host_packer():
+    """The jitted frame builder must be bit-identical to pack_tables —
+    the whole device path rests on it."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = random.Random(13)
+    checked = 0
+    for trial in range(20):
+        h = gen_history(rng, n_procs=rng.randint(2, 5),
+                        n_ops=rng.randint(8, 60),
+                        corrupt=(trial % 3 == 0))
+        p = wgl.pack_register_history(h)
+        if not p.ok or not wgl_mxu.supported(p):
+            continue
+        r_pad = max(wgl.bucket(p.R), wgl_mxu.TSUB)
+        t_host, s_host = wgl_mxu.pack_tables(p, r_pad)
+        i32, u16 = wgl_mxu.pack_perop(p, r_pad)
+        build = jax.jit(lambda a, b, rp=r_pad:
+                        wgl_mxu._build_tables_one(jnp, lax, a, b, rp))
+        t_dev, s_dev = [np.asarray(x)
+                        for x in build(jnp.asarray(i32), jnp.asarray(u16))]
+        assert (t_dev == t_host).all(), f"trial {trial}: table mismatch"
+        assert (s_dev == s_host).all(), f"trial {trial}: scal mismatch"
+        checked += 1
+    assert checked >= 10, f"only {checked}/20 comparable"
+
+
+def test_batch_matches_singles():
+    rng = random.Random(31)
+    hs = [gen_history(rng, n_procs=3, n_ops=rng.randint(8, 40),
+                      corrupt=(i % 4 == 0)) for i in range(12)]
+    packs = [wgl.pack_register_history(h) for h in hs]
+    outs = wgl_mxu.check_packed_batch_mxu(packs)
+    if outs is None:
+        pytest.skip("no supported packs in sample")
+    for p, out in zip(packs, outs):
+        if out is None:
+            assert not wgl_mxu.supported(p)
+            continue
+        single = wgl_mxu.check_packed_mxu(p)
+        assert out["valid?"] == single["valid?"], (out, single)
+
+
+def test_known_good_and_bad_fixtures():
+    def H(*ops):
+        from jepsen_etcd_tpu.core.op import Op
+        from jepsen_etcd_tpu.core.history import History
+        out = []
+        for i, o in enumerate(ops):
+            o = Op(o)
+            o["index"] = i
+            o.setdefault("time", i)
+            out.append(o)
+        return History(out)
+
+    def inv(p, f, v):
+        return {"type": "invoke", "process": p, "f": f, "value": v}
+
+    def ok(p, f, v):
+        return {"type": "ok", "process": p, "f": f, "value": v}
+
+    good = H(inv(0, "write", [None, "a"]), ok(0, "write", [None, "a"]),
+             inv(0, "read", [None, None]), ok(0, "read", [None, "a"]))
+    bad = H(inv(0, "write", [None, "a"]), ok(0, "write", [None, "a"]),
+            inv(0, "read", [None, None]), ok(0, "read", [None, "zzz"]))
+    pg = wgl.pack_register_history(good)
+    pb = wgl.pack_register_history(bad)
+    assert wgl_mxu.check_packed_mxu(pg)["valid?"] is True
+    assert wgl_mxu.check_packed_mxu(pb)["valid?"] is False
+
+
+def test_unsupported_shapes_return_none():
+    p = wgl.Packed(ok=False, reason="nope")
+    assert wgl_mxu.check_packed_mxu(p) is None
+    assert wgl_mxu.supported(p) is False
