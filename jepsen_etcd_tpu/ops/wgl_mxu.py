@@ -1,49 +1,48 @@
 """MXU-compacted Pallas wave kernel for the WGL frontier BFS.
 
 Second-generation fused kernel (supersedes ops/wgl_pallas.py on its
-shape class: W <= 32 window, no info ops). The r3 kernel's cost was
+shape class: W <= 64 window, no info ops). The r3 kernel's cost was
 measured to be dominated by vector->scalar round trips in its greedy
 dedupe pick loop (~1.2 us per pick on a v5e through axon) plus one
-DMA-visible stream per table; this kernel's wave body contains ZERO
-vector->scalar reductions and one table stream:
+DMA-visible stream per table; and every device engine pays the axon
+tunnel's measured ~100 ms round-trip latency per synchronized call and
+~30-50 MB/s effective host->device bandwidth. This kernel's design
+removes all three costs:
 
-- the frontier lives in packed (8, 128) int32 planes: candidate
-  (op o, state s) sits at position (p, q) with s = 8*(q//32) + p and
-  o = q % 32 — 32 states x 32 window ops = 1024 candidate slots in
-  ONE vreg per payload plane;
-- per-depth tables ship as ONE consolidated [R_pad, 256] int16 array
-  (a1/a2 value ids biased +1, version and ceiling RELATIVE to the
-  row's forced-update count so they fit int16, predecessor mask split
-  16/16) — one HBM stream instead of eight, half the host->device
-  bytes of the r3 layout (the axon tunnel moves ~0.5-1 GB/s, so
-  transfer bytes are first-order);
-- successor compaction is dedupe-FREE: candidates get dense ranks
-  from a log-shift prefix sum (pltpu.roll — all vector domain), and
-  an MXU one-hot matmul scatters payloads into frontier rows. The
-  window mask rides two f32 matmuls (16 bits each — f32 holds <= 2^16
-  exactly), value ids one (gated n_values < 2^16). Without dedupe,
-  states converging to the same (window, value) occupy multiple rows;
-  that only costs capacity (overflow -> the complete jnp ladder),
-  never soundness — BFS acceptance is witness-based;
-- acceptance / overflow / peak-frontier / waves are carried as VECTOR
-  flag planes folded elementwise each wave and decoded on host from
-  the final (32, 128) output block. The only scalar sync is a
-  frontier-death check every DONE_EVERY waves, which lets finished
-  (or padding) grid steps skip the body.
-
-Measured on the 10k-op register history (v5e through axon): ~2.5 us
-per wave vs ~7 us (r3 pick-loop kernel) vs ~100 us (jnp ladder), with
-host->device bytes halved. The batched variant runs K keys in ONE
-pallas dispatch (grid (K, R_pad)) — one tunnel round trip total,
-which is what makes the TPU competitive with the in-process native
-DFS sweep on the key-DP axis (SURVEY §2.3, register.clj:108-119).
+- the frontier lives in packed (NR, 128) int32 planes: candidate
+  (op o, state s) sits at position (p, q) with s = NR*(q//wk) + p and
+  o = q % wk, where wk is the window width (32 or 64) and NR = F*wk/128
+  — F=32 states x wk window ops per wave, one vreg per payload plane
+  (wk=32) or two (wk=64);
+- per-op data ships as ~32 B/op compact vectors (the [R, W] frames are
+  pure gathers over them — see wgl._pack_register_history) and a
+  jitted device-side builder materializes the [R_pad, TLANES] frame
+  table in HBM, bit-identical to the host packer (contract-tested);
+- successor compaction is pick-free: candidates get dense ranks from
+  log-shift prefix sums (pltpu.roll — all vector domain), and MXU
+  one-hot matmuls scatter payloads into frontier rows. Payloads ride
+  as 8-bit limbs — exact in bf16 (Mosaic's single-pass matmul feeds
+  the MXU bf16, 8 mantissa bits) — all limbs in ONE matmul via a
+  (PL, NP) lhs;
+- the compacted frontier is deduped exactly (F-1 roll-compares on
+  tiny row vectors) so duplicate multiplicity cannot compound across
+  waves (no-dedupe peak measured 110 vs true frontier 14; with it 25);
+- acceptance / overflow / peak-frontier / waves ride as VECTOR flag
+  planes folded elementwise and decoded on host from the final
+  (32, 128) output block. The only scalar sync is a frontier-death
+  check every DONE_EVERY waves, which lets finished (or padding) grid
+  steps skip the body;
+- the batched variant runs K keys in ONE pallas dispatch
+  (grid (K, R_pad)) — one tunnel round trip for the whole key batch,
+  which is what makes the TPU competitive with the in-process native
+  DFS sweep on the key-DP axis (SURVEY §2.3, register.clj:108-119).
 
 Soundness contract: definitive answers only. accepted=True is
 witnessed by a surviving path (valid even if earlier waves
 overflowed); accepted=False is only reported when no wave overflowed;
-anything else degrades to {"overflow": True} and the caller's
-complete ladder. Differentially fuzzed against the jnp kernel and
-both CPU oracles in tests/test_wgl_mxu.py.
+anything else degrades to {"overflow": True} and the caller's complete
+jnp ladder. Differentially fuzzed against the jnp kernel and both CPU
+oracles in tests/test_wgl_mxu.py.
 
 Reference role: hot path of the Knossos-equivalent checker
 (register.clj:110-112); the reference has no analog (Knossos is a JVM
@@ -59,108 +58,100 @@ import numpy as np
 from .wgl import (CAS, NO_ASSERT, READ, WRITE, WILDCARD,
                   Packed, bucket)
 
-F = 32            # frontier capacity (states; no-dedupe rows)
-W = 32            # window width (one 32-bit mask)
-SEG = 128 // W    # states per packed sublane row (4)
-NP = 8 * 128      # packed candidate slots
-TLANES = 128      # int32 table lanes: 4 segments of 32, two 16-bit
-                  # attrs per lane (int16 memrefs can't take dynamic
-                  # sublane loads, so attrs pair up inside int32 lanes)
-TSUB = 8          # int32 block sublane tile
+F = 32            # frontier capacity (states)
+W_SUPPORTED = (32, 64)
+TSUB = 8          # int32 table block sublane tile
 DONE_EVERY = 8    # waves between frontier-death scalar checks
 V_SENT = np.int16(-32768)   # "never matches" relative version
 C_INF = np.int16(32767)     # "no ceiling" relative ceiling
 VAL_MAX = 2 ** 16 - 3       # value-id budget (uint16 biased +1)
 
-# lane-segment layout: segment g (lanes 32g..32g+32) holds the attr
-# pair (low 16 bits | high 16 bits)
-G_A1A2, G_VERCEIL, G_PRED, G_FSK = range(4)
-# 8-bit payload limbs through the compaction matmul
-L_W0, L_W1, L_W2, L_W3, L_V0, L_V1, L_FILL = range(7)
-PL = 7
+# table lane-segment layout (each segment is wk lanes):
+# 0: a1|a2 pair, 1: ver|ceil pair, 2..2+NW-1: pred words, last: fsk
 # int32 SMEM scal columns
-S_SHIFT, S_CEILB, S_UPD, S_R = range(4)
-# output plane rows (each flag is an (8,128) plane in the (32,128) out)
-O_ACC, O_OVF, O_PEAK, O_WAVES = range(4)
+S_SHIFT, S_CEILB, S_UPD0, S_UPD1, S_R = range(5)
+SCAL_COLS = 8
+
+U16_NOASSERT = 65535
+U16_INF = 65534
+U16_NEVER = 65533   # version assertion that can never match
+# uint16 per-op col layout
+C_A1, C_A2, C_VER, C_FSK1, C_PRED, C_CEIL, C_LO, C_SHIFT, C_CEILB, \
+    C_UF, C_R, C_SPARE = range(12)
+
+
+def _dims(wk: int):
+    """Derived layout constants for a window width."""
+    nw = wk // 32            # mask words
+    nr = F * wk // 128       # plane rows (candidate slots = F*wk)
+    np_ = F * wk             # packed candidate slots
+    segk = 128 // wk         # states per plane-row set
+    pl = 4 * nw + 3          # payload limbs: w bytes + v lo/hi + filled
+    tlanes = wk * (3 + nw)
+    tlanes = -(-tlanes // 128) * 128    # lane-tile align
+    return nw, nr, np_, segk, pl, tlanes
 
 
 def supported(p: Packed) -> bool:
-    """Preconditions: packed OK, one mask word, no info ops, value ids
-    and history length within the uint16 shipping budget (others fall
-    back to the jnp ladder)."""
-    return (bool(p.ok) and p.w == W and p.I == 0 and p.R > 0
+    """Preconditions: packed OK, one- or two-word window, no info ops,
+    value ids and history length within the uint16 shipping budget
+    (others fall back to the jnp ladder)."""
+    return (bool(p.ok) and p.w in W_SUPPORTED and p.I == 0 and p.R > 0
             and p.n_values < VAL_MAX and p.R < 65000)
 
 
 def pack_tables(p: Packed, r_pad: int):
-    """Consolidate a Packed's per-depth frames into the kernel's
-    [r_pad, 256] int16 table + [r_pad, 4] int32 scal (see layout
-    above). Relative encodings keep everything in int16 soundly:
-    a row-d frame entry can only be satisfied while the state's
-    version sits in [u_forced[d], u_forced[d] + W], so version
-    assertions and ceilings are stored relative to u_forced[d] and
-    out-of-range assertions become the never-matching sentinel."""
-    R = p.R
+    """Host reference packer: consolidate a Packed's per-depth frames
+    into the kernel's [r_pad, TLANES] int32 table + [r_pad, SCAL_COLS]
+    int32 scal. CANONICAL relative encodings (shared with the device
+    builder — the bit-identity contract requires one rule): a reachable
+    relative version is 0..wk+1, so any assertion outside [-1, wk+1]
+    maps to the never-matching -32767; ceilings prune via
+    version <= ceil with version in [0, wk], so values clamp into
+    [-1, wk+1]."""
+    R, wk = p.R, p.w
+    nw, nr, np_, segk, pl, tlanes = _dims(wk)
     uf = p.u_forced.astype(np.int64)                      # [R]
-    tab = np.zeros((r_pad, TLANES), dtype=np.int32)
+    tab = np.zeros((r_pad, tlanes), dtype=np.int32)
 
     def pair(lo_u16, hi_u16):
         return (lo_u16.astype(np.uint32)
                 | (hi_u16.astype(np.uint32) << 16)).view(np.int32)
 
-    def seg(g):
-        return tab[:R, 32 * g:32 * g + 32]
+    def seg(j):
+        return tab[:R, wk * j:wk * j + wk]
 
     a1u = np.where(p.a1 == WILDCARD, 0,
                    p.a1 + 1).astype(np.uint16)            # biased
     a2u = (p.a2 + 1).astype(np.uint16)
-    seg(G_A1A2)[...] = pair(a1u, a2u)
-    # CANONICAL relative encodings (shared with the device builder —
-    # the bit-identity contract requires one rule, not two clippings):
-    # a reachable relative version is 0..W+1, so any assertion outside
-    # [-1, W+1] maps to the never-matching -32767; ceilings prune via
-    # version <= ceil with version in [0, W], so values clamp into
-    # [-1, W+1] (any value past W prunes nothing, any below 0 prunes
-    # everything)
+    seg(0)[...] = pair(a1u, a2u)
     rel = p.ver.astype(np.int64) - uf[:, None]
-    rel = np.where((rel < -1) | (rel > W + 1), -32767, rel)
+    rel = np.where((rel < -1) | (rel > wk + 1), -32767, rel)
     rel = np.where(p.ver == NO_ASSERT, V_SENT, rel).astype(np.int16)
     relc = np.clip(p.ceil_frame.astype(np.int64) - uf[:, None],
-                   -1, W + 1)
+                   -1, wk + 1)
     relc = np.where(p.ceil_frame >= 2 ** 30, C_INF, relc).astype(np.int16)
-    seg(G_VERCEIL)[...] = pair(rel.view(np.uint16), relc.view(np.uint16))
-    pred = p.pred_frame[:, :, 0]                          # [R, W] uint32
-    seg(G_PRED)[...] = pred.view(np.int32)                # full 32 bits
+    seg(1)[...] = pair(rel.view(np.uint16), relc.view(np.uint16))
+    for wi in range(nw):
+        seg(2 + wi)[...] = p.pred_frame[:, :, wi].view(np.int32)
     fsk = np.where(p.static_ok, p.f_code.astype(np.uint16) + 1,
                    0).astype(np.uint16)
-    seg(G_FSK)[...] = pair(fsk, np.zeros_like(fsk))
+    seg(2 + nw)[...] = pair(fsk, np.zeros_like(fsk))
 
-    scal = np.zeros((r_pad, 4), dtype=np.int32)
+    scal = np.zeros((r_pad, SCAL_COLS), dtype=np.int32)
     scal[:R, S_SHIFT] = p.shift
-    cb = np.clip(p.ceil_beyond.astype(np.int64) - uf, -1, W + 1)
+    cb = np.clip(p.ceil_beyond.astype(np.int64) - uf, -1, wk + 1)
     scal[:R, S_CEILB] = np.where(p.ceil_beyond >= 2 ** 30, 2 ** 30, cb)
-    scal[:R, S_UPD] = p.upd_mask[:, 0].view(np.int32)
+    for wi in range(nw):
+        scal[:R, S_UPD0 + wi] = p.upd_mask[:, wi].view(np.int32)
     scal[:, S_R] = R
     return tab, scal
 
 
-# per-op compact shipping format (device-side frame building): the
-# [R, W] frames are pure gathers over per-op vectors (see
-# wgl._pack_register_history), so the host ships ~32 B/op and a jitted
-# builder materializes the [r_pad, 128] table in HBM — the axon tunnel
-# moves ~30-50 MB/s under honest sync, so shipping frames (~512 B/op)
-# dominated every check
-U16_NOASSERT = 65535
-U16_INF = 65534
-U16_NEVER = 65533   # version assertion that can never match
-# uint16 col layout
-C_A1, C_A2, C_VER, C_FSK1, C_PRED, C_CEIL, C_LO, C_SHIFT, C_CEILB, \
-    C_UF, C_R, C_SPARE = range(12)
-
-
 def pack_perop(p: Packed, r_pad: int):
     """Compact per-op arrays for the device frame builder: int32
-    [r_pad, 4] (invoke/return time ranks) + uint16 [r_pad, 12]."""
+    [r_pad, 4] (invoke/return time ranks) + uint16 [r_pad, 12].
+    Width-agnostic — the window geometry is carried by lo/shift."""
     R = p.R
     i32 = np.zeros((r_pad, 4), dtype=np.int32)
     i32[:R, 0] = p.inv_rank
@@ -186,30 +177,31 @@ def pack_perop(p: Packed, r_pad: int):
     uf = p.u_forced.astype(np.int64)
     relb = np.where(p.ceil_beyond >= 2 ** 30, U16_INF - 1,
                     np.clip(p.ceil_beyond.astype(np.int64) - uf,
-                            -1, W + 1) + 1)         # biased +1, -1 -> 0
+                            -1, p.w + 1) + 1)   # biased +1, -1 -> 0
     u16[:R, C_CEILB] = relb
     u16[:R, C_UF] = uf
     u16[:, C_R] = R
     return i32, u16
 
 
-def _build_tables_one(jnp, lax, i32, u16, r_pad: int):
-    """Device-side frame builder for ONE key: (r_pad, 4) int32 +
-    (r_pad, 12) uint16 -> (r_pad, TLANES) int32 tab, (r_pad, 4) int32
-    scal. Bit-identical to pack_tables (differentially tested)."""
+def _build_tables_one(jnp, lax, i32, u16, r_pad: int, wk: int):
+    """Device-side frame builder for ONE key: -> (r_pad, TLANES) int32
+    tab, (r_pad, SCAL_COLS) int32 scal. Bit-identical to pack_tables
+    (differentially tested)."""
+    nw, nr, np_, segk, pl, tlanes = _dims(wk)
     u = u16.astype(jnp.int32)
     invr = i32[:, 0]
     retr = i32[:, 1]
     R = u[0, C_R]
     kr = lax.broadcasted_iota(jnp.int32, (r_pad, 1), 0)
-    o = lax.broadcasted_iota(jnp.int32, (r_pad, W), 1)
+    o = lax.broadcasted_iota(jnp.int32, (r_pad, wk), 1)
     lo = u[:, C_LO:C_LO + 1]
     pos = lo + o
     in_range = (pos < R) & (kr < R)
     idx = jnp.clip(pos, 0, jnp.maximum(R - 1, 0))
 
     def g(col):
-        return jnp.take(u[:, col], idx, axis=0)      # (r_pad, W)
+        return jnp.take(u[:, col], idx, axis=0)      # (r_pad, wk)
 
     fsk = jnp.where(in_range & (g(C_PRED) <= kr), g(C_FSK1), 0)
     a1p = g(C_A1)
@@ -219,84 +211,111 @@ def _build_tables_one(jnp, lax, i32, u16, r_pad: int):
     raw = (verabs - 1) - uf
     relver = jnp.where(
         verabs == U16_NOASSERT, -32768,
-        jnp.where((verabs == U16_NEVER) | (raw < -1) | (raw > W + 1),
+        jnp.where((verabs == U16_NEVER) | (raw < -1) | (raw > wk + 1),
                   -32767, raw))
     ceilabs = g(C_CEIL)
     relceil = jnp.where((ceilabs == U16_INF) | ~in_range, 32767,
-                        jnp.clip((ceilabs - 1) - uf, -1, W + 1))
-    retg = jnp.take(retr, idx, axis=0)               # (r_pad, W)
+                        jnp.clip((ceilabs - 1) - uf, -1, wk + 1))
+    retg = jnp.take(retr, idx, axis=0)               # (r_pad, wk)
     invg = jnp.take(invr, idx, axis=0)
     bits = ((retg[:, None, :] < invg[:, :, None])
-            & in_range[:, None, :])                  # (r_pad, W, W) c-minor
-    wts = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))
-    pm = (bits.astype(jnp.uint32) * wts[None, None, :]).sum(-1)
+            & in_range[:, None, :])                  # (r_pad, wk, wk)
+    wts32 = (jnp.uint32(1) << (jnp.arange(wk, dtype=jnp.uint32) % 32))
+    pms = []
+    ums = []
     isupd = (g(C_FSK1) >= 2) & in_range
-    um = (isupd.astype(jnp.uint32) * wts[None, :]).sum(-1)  # (r_pad,)
+    for wi in range(nw):
+        sl = slice(32 * wi, 32 * wi + 32)
+        pms.append((bits[:, :, sl].astype(jnp.uint32)
+                    * wts32[None, None, sl]).sum(-1))
+        ums.append((isupd[:, sl].astype(jnp.uint32)
+                    * wts32[None, sl]).sum(-1))
 
     def pair(lo16, hi16):
         return (lo16 & 0xFFFF) | (hi16 << 16)
 
-    tab = jnp.concatenate([
-        pair(a1p, a2p),
-        pair(relver, relceil),
-        lax.bitcast_convert_type(pm, jnp.int32),
-        pair(fsk, jnp.zeros_like(fsk)),
-    ], axis=1)                                       # (r_pad, TLANES)
+    parts = [pair(a1p, a2p), pair(relver, relceil)]
+    parts += [lax.bitcast_convert_type(pm, jnp.int32) for pm in pms]
+    parts += [pair(fsk, jnp.zeros_like(fsk))]
+    tab = jnp.concatenate(parts, axis=1)
+    if tab.shape[1] < tlanes:
+        tab = jnp.pad(tab, ((0, 0), (0, tlanes - tab.shape[1])))
     tab = jnp.where(kr < R, tab, 0)
-    # ceil_beyond decode: 65533 = INF, else biased by +1
+    # ceil_beyond decode: U16_INF-1 = INF marker, else biased by +1
     relb = jnp.where(u[:, C_CEILB] == U16_INF - 1, 2 ** 30,
                      u[:, C_CEILB] - 1)
     inrow = kr[:, 0] < R
-    scal = jnp.stack([jnp.where(inrow, u[:, C_SHIFT], 0),
-                      jnp.where(inrow, relb, 0),
-                      jnp.where(inrow,
-                                lax.bitcast_convert_type(um, jnp.int32), 0),
-                      jnp.full((r_pad,), 1, jnp.int32) * R], axis=1)
+    cols = [jnp.where(inrow, u[:, C_SHIFT], 0),
+            jnp.where(inrow, relb, 0)]
+    for wi in range(2):
+        if wi < nw:
+            cols.append(jnp.where(
+                inrow, lax.bitcast_convert_type(ums[wi], jnp.int32), 0))
+        else:
+            cols.append(jnp.zeros((r_pad,), jnp.int32))
+    cols.append(jnp.full((r_pad,), 1, jnp.int32) * R)
+    cols += [jnp.zeros((r_pad,), jnp.int32)] * (SCAL_COLS - len(cols))
+    scal = jnp.stack(cols, axis=1)
     return tab, scal
 
 
-def _wave_body(jnp, lax, pl, pltpu, row16, shift, ceilb, upd, kk, R,
-               stw_p, stv_p, alive_p, xs, rs, acc_p, ovf_p, peak_p,
-               wav_p):
+def _wave_body(jnp, lax, pl_mod, pltpu, wk, row_t, shift, ceilb, upd0,
+               upd1, kk, R, stw_p, stv_p, alive_p, xs, rs, acc_p,
+               ovf_p, peak_p, wav_p):
     """One BFS wave on the packed planes. No vector->scalar syncs."""
-    lane = lax.broadcasted_iota(jnp.int32, (8, 128), 1)
-    o = lane % W                         # window op index per slot
-    row = row16
+    nw, nr, np_, segk, pl, tlanes = _dims(wk)
+    lane = lax.broadcasted_iota(jnp.int32, (nr, 128), 1)
+    o = lane % wk                        # window op index per slot
+    obit = o % 32                        # bit within its mask word
+    o_hi = o >= 32                       # True: bit lives in word 1
 
-    def seg(g):
-        s = row[:, 32 * g:32 * g + 32]
-        sp = jnp.pad(s, ((0, 0), (0, 96)))
-        sp = sp | pltpu.roll(sp, 32, 1) | pltpu.roll(sp, 64, 1) \
-            | pltpu.roll(sp, 96, 1)
-        return jnp.broadcast_to(sp, (8, 128))
+    def seg(j):
+        s = row_t[:, wk * j:wk * j + wk]
+        if wk < 128:
+            s = jnp.pad(s, ((0, 0), (0, 128 - wk)))
+            d = wk
+            while d < 128:
+                s = s | pltpu.roll(s, d, 1)
+                d += wk
+        return jnp.broadcast_to(s, (nr, 128))
 
-    g_av = seg(G_A1A2)
-    g_vc = seg(G_VERCEIL)
+    g_av = seg(0)
+    g_vc = seg(1)
     a1 = g_av & 0xFFFF                   # biased value ids (0 = wildcard)
     a2 = (g_av >> 16) & 0xFFFF
     rver = (g_vc << 16) >> 16            # sign-extended int16
     rceil = g_vc >> 16                   # arithmetic shift: signed
-    pmask = seg(G_PRED).astype(jnp.uint32)
-    fsk = seg(G_FSK) & 0xFFFF
+    pmask = [seg(2 + wi).astype(jnp.uint32) for wi in range(nw)]
+    fsk = seg(2 + nw) & 0xFFFF
 
-    sw = stw_p[...].astype(jnp.uint32)
-    sv = stv_p[...]                      # biased value ids (0 = unset? no:
-    # sv stores value id + 1 with 1 == NONE_VAL's bias; init plane is 1)
+    # window words: word wi lives at plane rows [wi*nr:(wi+1)*nr]
+    sw = [stw_p[wi * nr:(wi + 1) * nr, :].astype(jnp.uint32)
+          for wi in range(nw)]
+    sv = stv_p[...]                      # biased value ids (1 = NONE)
     alive = alive_p[...] != 0
 
-    not_set = ((sw >> o.astype(jnp.uint32)) & jnp.uint32(1)) == 0
-    preds_in = (sw & pmask) == pmask
+    osafe = obit.astype(jnp.uint32)
+    if nw == 1:
+        mybits = sw[0] >> osafe
+    else:
+        mybits = jnp.where(o_hi, sw[1] >> osafe, sw[0] >> osafe)
+    not_set = (mybits & jnp.uint32(1)) == 0
+    preds_in = (sw[0] & pmask[0]) == pmask[0]
     version = lax.population_count(
-        sw & jnp.uint32(upd)).astype(jnp.int32)   # relative to u_forced
+        sw[0] & jnp.uint32(upd0)).astype(jnp.int32)
+    if nw == 2:
+        preds_in = preds_in & ((sw[1] & pmask[1]) == pmask[1])
+        version = version + lax.population_count(
+            sw[1] & jnp.uint32(upd1)).astype(jnp.int32)
     # per-STATE min ceiling among its not-yet-linearized window ops:
-    # a state's 32 candidate lanes live in one 32-lane segment, so this
+    # a state's wk candidate lanes live in one wk-lane segment, so this
     # is a segment-local all-reduce — butterfly of wrapped rolls (the
     # wrap re-enters the same segment, so no cross-state mixing)
     mc = jnp.where(not_set, rceil, 2 ** 30)
     d = 1
-    while d < W:
-        wrapped = jnp.where(lane % W >= d, pltpu.roll(mc, d, 1),
-                            pltpu.roll(mc, d - W + 128, 1))
+    while d < wk:
+        wrapped = jnp.where(lane % wk >= d, pltpu.roll(mc, d, 1),
+                            pltpu.roll(mc, d - wk + 128, 1))
         mc = jnp.minimum(mc, wrapped)
         d *= 2
     min_ceil = jnp.minimum(mc, ceilb)
@@ -311,46 +330,70 @@ def _wave_body(jnp, lax, pl, pltpu, row16, shift, ceilb, upd, kk, R,
     read_ok = is_read & ((a1 == 0) | (a1 == sv))
     model_ok = read_ok | is_write | (is_cas & (a1 == sv))
 
-    bitb = jnp.uint32(1) << o.astype(jnp.uint32)
-    new_w_full = sw | bitb
-    ssafe = jnp.minimum(shift, 31).astype(jnp.uint32)
-    low = jnp.where(shift >= 32, jnp.uint32(0xFFFFFFFF),
-                    (jnp.uint32(1) << ssafe) - jnp.uint32(1))
-    slide_ok = (new_w_full & low) == low
-    new_w = jnp.where(shift >= 32, jnp.uint32(0), new_w_full >> ssafe)
+    bitb = jnp.uint32(1) << osafe
+    if nw == 1:
+        nwf = [sw[0] | bitb]
+    else:
+        nwf = [sw[0] | jnp.where(o_hi, jnp.uint32(0), bitb),
+               sw[1] | jnp.where(o_hi, bitb, jnp.uint32(0))]
+    # slide: the `shift` lowest bits of the (nw*32)-bit window fall off
+    # and must all be set; per-word low masks with clamped shifts
+    sh = shift
+
+    def low_mask(wi):
+        k = jnp.clip(sh - 32 * wi, 0, 32)
+        ks = jnp.minimum(k, 31).astype(jnp.uint32)
+        return jnp.where(k >= 32, jnp.uint32(0xFFFFFFFF),
+                         (jnp.uint32(1) << ks) - jnp.uint32(1))
+
+    slide_ok = (nwf[0] & low_mask(0)) == low_mask(0)
+    if nw == 2:
+        slide_ok = slide_ok & ((nwf[1] & low_mask(1)) == low_mask(1))
+    # shifted window: (hi:lo) >> sh, word-wise with clamped amounts
+    s0 = jnp.minimum(sh, 31).astype(jnp.uint32)
+    if nw == 1:
+        new_w = [jnp.where(sh >= 32, jnp.uint32(0), nwf[0] >> s0)]
+    else:
+        s32 = jnp.clip(sh - 32, 0, 31).astype(jnp.uint32)
+        upshift = jnp.clip(32 - sh, 1, 31).astype(jnp.uint32)
+        lo_small = (nwf[0] >> s0) | jnp.where(
+            sh == 0, jnp.uint32(0), nwf[1] << upshift)
+        lo2 = jnp.where(sh >= 64, jnp.uint32(0),
+                        jnp.where(sh >= 32, nwf[1] >> s32, lo_small))
+        hi2 = jnp.where(sh >= 32, jnp.uint32(0), nwf[1] >> s0)
+        new_w = [lo2, hi2]
 
     valid = (alive & (fsk > 0) & not_set & preds_in
              & ver_ok & model_ok & slide_ok)
     new_v = jnp.where(is_read, sv, jnp.where(is_write, a1, a2))
 
-    # partial dedupe (soundness-free: only kills candidates identical
-    # to a SURVIVING one). Duplicates arise when distinct states
-    # converge on the same (window, value); without any dedupe their
-    # multiplicity compounds every wave and saturates capacity
-    # (measured: peak 110 vs true frontier 14). Two cheap passes:
-    # within a column (same op, states in sublanes) and across
-    # segments of a row. Compaction assigns surviving copies
-    # CONSECUTIVE ranks, which places them in one column next wave —
-    # so cross-position duplicates collapse within two waves and
-    # multiplicity stays O(segments) instead of compounding.
-    nwb = lax.bitcast_convert_type(new_w, jnp.int32)
+    # partial candidate dedupe (soundness-free: only kills candidates
+    # identical to a SURVIVING one); the exact frontier dedupe below is
+    # what stops compounding, this pass just relieves capacity pressure
+    # within a wave. Stack [w words, v, valid] so each compare needs
+    # ONE roll.
+    nwb = [lax.bitcast_convert_type(x, jnp.int32) for x in new_w]
     vld = valid.astype(jnp.int32)
-    srow_f = lax.broadcasted_iota(jnp.int32, (8, 128), 0)
-    # stack [w, v, valid] into one (24, 128) array so each compare
-    # needs ONE roll (rolls dominated this pass: 30 -> 10)
-    st24 = jnp.concatenate([nwb, new_v, vld], axis=0)
+    srow_f = lax.broadcasted_iota(jnp.int32, (nr, 128), 0)
+    stk = jnp.concatenate(nwb + [new_v, vld], axis=0)
+
+    def blocks(r):
+        ws = [r[wi * nr:(wi + 1) * nr] for wi in range(nw)]
+        return ws, r[nw * nr:(nw + 1) * nr], r[(nw + 1) * nr:]
+
+    def same_mask(r, guard):
+        ws, v2, vl2 = blocks(r)
+        eq = (nwb[0] == ws[0])
+        for wi in range(1, nw):
+            eq = eq & (nwb[wi] == ws[wi])
+        return eq & (new_v == v2) & (vl2 != 0) & guard
+
     dup = srow_f < 0             # all-false plane
-    for d in range(1, 8):        # vs candidate d sublanes above
-        r24 = pltpu.roll(st24, d, 0)
-        same = ((nwb == r24[0:8]) & (new_v == r24[8:16])
-                & (r24[16:24] != 0) & (srow_f >= d))
-        dup = dup | same
-    for g in range(1, SEG):      # vs candidate g segments to the left
-        dd = 32 * g
-        r24 = pltpu.roll(st24, dd, 1)
-        same = ((nwb == r24[0:8]) & (new_v == r24[8:16])
-                & (r24[16:24] != 0) & (lane >= dd))
-        dup = dup | same
+    for d in range(1, min(nr, 8)):       # vs candidate d sublanes above
+        dup = dup | same_mask(pltpu.roll(stk, d, 0), srow_f >= d)
+    for gs in range(1, segk):            # vs segments to the left
+        dd = wk * gs
+        dup = dup | same_mask(pltpu.roll(stk, dd, 1), lane >= dd)
     valid = valid & ~dup
 
     # dense ranks via log-shift prefix sums (vector only)
@@ -361,108 +404,113 @@ def _wave_body(jnp, lax, pl, pltpu, row16, shift, ceilb, upd, kk, R,
         acc = acc + jnp.where(lane >= d, pltpu.roll(acc, d, 1), 0)
         d *= 2
     rowtot = acc[:, 127:128]
-    srow8 = lax.broadcasted_iota(jnp.int32, (8, 1), 0)
+    srow1 = lax.broadcasted_iota(jnp.int32, (nr, 1), 0)
     racc = rowtot
     d = 1
-    while d < 8:
-        racc = racc + jnp.where(srow8 >= d, pltpu.roll(racc, d, 0), 0)
+    while d < nr:
+        racc = racc + jnp.where(srow1 >= d, pltpu.roll(racc, d, 0), 0)
         d *= 2
     rank = acc - vi + (racc - rowtot)    # exclusive global rank
 
     # flags BEFORE compaction: acceptance is witness-based; overflow =
     # any candidate ranked past capacity
     last = jnp.where(kk + 1 == R, 1, 0)  # scalar 0/1
-    acc_p[...] = acc_p[...] | (vi * last)
-    ovf_p[...] = ovf_p[...] | (valid & (rank >= F)).astype(jnp.int32)
-    peak_p[...] = jnp.maximum(peak_p[...], jnp.where(valid, rank + 1, 0))
+    acc_p[0:nr, :] = acc_p[0:nr, :] | (vi * last)
+    ovf_p[0:nr, :] = ovf_p[0:nr, :] | (valid & (rank >= F)).astype(
+        jnp.int32)
+    peak_p[0:nr, :] = jnp.maximum(peak_p[0:nr, :],
+                                  jnp.where(valid, rank + 1, 0))
     wav_p[...] = wav_p[...] + (alive_p[...] != 0).astype(jnp.int32)
 
-    rank = jnp.where(valid, rank, NP + 7)
+    rank = jnp.where(valid, rank, np_ + 7)
     rs[...] = rank
-    r_flat = rs.reshape(1, NP)[...]
-    rio = lax.broadcasted_iota(jnp.int32, (F, NP), 0)
-    # bf16 one-hot: Mosaic's single-pass matmul feeds the MXU bf16
-    # (8 mantissa bits), so payloads ride as 8-bit limbs — exact in
-    # bf16 — and ALL limbs compact in ONE matmul via a (PL, NP) lhs
-    A = (jnp.broadcast_to(r_flat, (F, NP)) == rio).astype(jnp.bfloat16)
+    r_flat = rs.reshape(1, np_)[...]
+    rio = lax.broadcasted_iota(jnp.int32, (F, np_), 0)
+    # bf16 one-hot: payloads ride as 8-bit limbs — exact in bf16 — and
+    # ALL limbs compact in ONE matmul via a (PL, NP) lhs
+    A = (jnp.broadcast_to(r_flat, (F, np_)) == rio).astype(jnp.bfloat16)
 
-    nwi = lax.bitcast_convert_type(new_w, jnp.int32)
-    limbs = ((nwi & 0xFF), ((nwi >> 8) & 0xFF), ((nwi >> 16) & 0xFF),
-             ((nwi >> 24) & 0xFF), (new_v & 0xFF), ((new_v >> 8) & 0xFF),
-             vi)
+    limbs = []
+    for wi in range(nw):
+        x = nwb[wi]
+        limbs += [(x & 0xFF), ((x >> 8) & 0xFF), ((x >> 16) & 0xFF),
+                  ((x >> 24) & 0xFF)]
+    limbs += [(new_v & 0xFF), ((new_v >> 8) & 0xFF), vi]
     for i, pl_ in enumerate(limbs):
-        xs[8 * i:8 * i + 8, :] = pl_
-    lhs = xs.reshape(PL, NP)[...].astype(jnp.bfloat16)
-    out7 = lax.dot_general(lhs, A, (((1,), (1,)), ((), ())),
+        xs[nr * i:nr * i + nr, :] = pl_
+    lhs = xs.reshape(pl, np_)[...].astype(jnp.bfloat16)
+    outp = lax.dot_general(lhs, A, (((1,), (1,)), ((), ())),
                            preferred_element_type=jnp.float32)  # (PL, F)
-    wl0 = out7[L_W0:L_W0 + 1]
-    wl1 = out7[L_W1:L_W1 + 1]
-    wl2 = out7[L_W2:L_W2 + 1]
-    wl3 = out7[L_W3:L_W3 + 1]
-    vl0 = out7[L_V0:L_V0 + 1]
-    vl1 = out7[L_V1:L_V1 + 1]
-    filled = out7[L_FILL:L_FILL + 1]
+    l_fill = pl - 1
+    filled = outp[l_fill:l_fill + 1]
 
     # EXACT frontier dedupe on the compacted (1, F) rows: kill a row
-    # identical to a lower-ranked filled row (F-1 roll-compares on one
-    # tiny vector). Candidate-level dups are only partially removable
-    # (cross-op convergences aren't roll-reachable), but deduping the
-    # KEPT frontier stops multiplicity compounding across waves — each
-    # wave's candidate count is then distinct successors plus that
-    # wave's primordial convergences only (measured: peak 60 -> ~25 on
-    # the repro class). Holes in the row space are harmless: ranks are
-    # recomputed from scratch next wave.
-    # combined int32 keys: one roll per compare instead of seven
-    cw = (wl0.astype(jnp.int32) + (wl1.astype(jnp.int32) << 8)
-          + (wl2.astype(jnp.int32) << 16) + (wl3.astype(jnp.int32) << 24))
-    cv = vl0.astype(jnp.int32) + (vl1.astype(jnp.int32) << 8)
+    # identical to a lower-ranked filled row. Combined int32 keys keep
+    # it at one roll per compare.
+    keys = []
+    for wi in range(nw):
+        base = 4 * wi
+        keys.append(outp[base + 0:base + 1].astype(jnp.int32)
+                    + (outp[base + 1:base + 2].astype(jnp.int32) << 8)
+                    + (outp[base + 2:base + 3].astype(jnp.int32) << 16)
+                    + (outp[base + 3:base + 4].astype(jnp.int32) << 24))
+    keys.append(outp[4 * nw:4 * nw + 1].astype(jnp.int32)
+                + (outp[4 * nw + 1:4 * nw + 2].astype(jnp.int32) << 8))
     fi = (filled > 0.5).astype(jnp.int32)
-    key3 = jnp.concatenate([cw, cv, fi], axis=0)          # (3, F)
+    keycat = jnp.concatenate(keys + [fi], axis=0)       # (nw+2, F)
+    nk = len(keys)
     lane_f = lax.broadcasted_iota(jnp.int32, (1, F), 1)
     dupr = lane_f < 0
     for d in range(1, F):
-        r3 = pltpu.roll(key3, d, 1)
-        eq = ((cw == r3[0:1]) & (cv == r3[1:2]) & (r3[2:3] != 0)
-              & (lane_f >= d))
-        dupr = dupr | eq
+        r3 = pltpu.roll(keycat, d, 1)
+        eq = (keys[0] == r3[0:1])
+        for j in range(1, nk):
+            eq = eq & (keys[j] == r3[j:j + 1])
+        dupr = dupr | (eq & (r3[nk:nk + 1] != 0) & (lane_f >= d))
     filled = jnp.where(dupr, 0.0, filled)
 
-    # pack all limb rows back into (8, 128) planes with two more
-    # matmuls: expand (PL, F) -> (8*PL, F) sublane-replicated rows
+    # pack all limb rows back into (nr, 128) planes with two more
+    # matmuls: expand (PL, F) -> (nr*PL, F) sublane-replicated rows
     # masked to their residue, then scatter segments via D
-    prow = lax.broadcasted_iota(jnp.int32, (PL, F), 0)
-    out7d = jnp.where(prow == L_FILL,
-                      jnp.broadcast_to(filled, (PL, F)), out7)
-    jio = lax.broadcasted_iota(jnp.int32, (8 * PL, PL), 0)
-    iio = lax.broadcasted_iota(jnp.int32, (8 * PL, PL), 1)
-    E = ((jio // 8) == iio).astype(jnp.bfloat16)          # (8PL, PL)
-    out56 = lax.dot_general(E, out7d.astype(jnp.bfloat16),
-                            (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    jio2 = lax.broadcasted_iota(jnp.int32, (8 * PL, F), 0)
-    rio2 = lax.broadcasted_iota(jnp.int32, (8 * PL, F), 1)
-    M1t = ((rio2 % 8) == (jio2 % 8)).astype(jnp.float32)
-    tmp = (out56 * M1t).astype(jnp.bfloat16)              # (8PL, F)
+    prow = lax.broadcasted_iota(jnp.int32, (pl, F), 0)
+    outd = jnp.where(prow == l_fill,
+                     jnp.broadcast_to(filled, (pl, F)), outp)
+    jio = lax.broadcasted_iota(jnp.int32, (nr * pl, pl), 0)
+    iio = lax.broadcasted_iota(jnp.int32, (nr * pl, pl), 1)
+    E = ((jio // nr) == iio).astype(jnp.bfloat16)       # (nr*PL, PL)
+    oute = lax.dot_general(E, outd.astype(jnp.bfloat16),
+                           (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+    jio2 = lax.broadcasted_iota(jnp.int32, (nr * pl, F), 0)
+    rio2 = lax.broadcasted_iota(jnp.int32, (nr * pl, F), 1)
+    M1t = ((rio2 % nr) == (jio2 % nr)).astype(jnp.float32)
+    tmp = (oute * M1t).astype(jnp.bfloat16)             # (nr*PL, F)
     rioD = lax.broadcasted_iota(jnp.int32, (F, 128), 0)
     lioD = lax.broadcasted_iota(jnp.int32, (F, 128), 1)
-    D = ((rioD // 8) == (lioD // 32)).astype(jnp.bfloat16)
-    plane56 = lax.dot_general(tmp, D, (((1,), (0,)), ((), ())),
-                              preferred_element_type=jnp.float32)
+    D = ((rioD // nr) == (lioD // wk)).astype(jnp.bfloat16)
+    planes = lax.dot_general(tmp, D, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
 
     def limb_plane(i):
-        return plane56[8 * i:8 * i + 8, :].astype(jnp.int32)
+        return planes[nr * i:nr * i + nr, :].astype(jnp.int32)
 
-    fplane = limb_plane(L_FILL)
-    stw_p[...] = jnp.where(
-        fplane != 0,
-        limb_plane(L_W0) + (limb_plane(L_W1) << 8)
-        + (limb_plane(L_W2) << 16) + (limb_plane(L_W3) << 24), 0)
+    fplane = limb_plane(l_fill)
+    for wi in range(nw):
+        base = 4 * wi
+        stw_p[wi * nr:(wi + 1) * nr, :] = jnp.where(
+            fplane != 0,
+            limb_plane(base) + (limb_plane(base + 1) << 8)
+            + (limb_plane(base + 2) << 16) + (limb_plane(base + 3) << 24),
+            0)
     stv_p[...] = jnp.where(
-        fplane != 0, limb_plane(L_V0) + (limb_plane(L_V1) << 8), 0)
+        fplane != 0,
+        limb_plane(4 * nw) + (limb_plane(4 * nw + 1) << 8), 0)
     alive_p[...] = fplane
 
 
-def _make_kernel(batched: bool):
+def _make_kernel(batched: bool, wk: int):
+    nw, nr, np_, segk, pl_n, tlanes = _dims(wk)
+
     def kernel(tab_ref, scal_ref, out_ref, stw_p, stv_p, alive_p, xs,
                rs, acc_p, ovf_p, peak_p, wav_p, sm):
         import jax
@@ -478,29 +526,30 @@ def _make_kernel(batched: bool):
 
         @pl.when(kk == 0)
         def _init():
-            lane = lax.broadcasted_iota(jnp.int32, (8, 128), 1)
-            srow = lax.broadcasted_iota(jnp.int32, (8, 128), 0)
-            init = ((srow == 0) & (lane < W)).astype(jnp.int32)
+            lane = lax.broadcasted_iota(jnp.int32, (nr, 128), 1)
+            srow = lax.broadcasted_iota(jnp.int32, (nr, 128), 0)
+            init = ((srow == 0) & (lane < wk)).astype(jnp.int32)
             alive_p[...] = init
-            stw_p[...] = jnp.zeros((8, 128), jnp.int32)
+            stw_p[...] = jnp.zeros((nw * nr, 128), jnp.int32)
             stv_p[...] = init  # biased NONE_VAL = 0 + 1
-            acc_p[...] = jnp.zeros((8, 128), jnp.int32)
-            ovf_p[...] = jnp.zeros((8, 128), jnp.int32)
+            acc_p[...] = jnp.zeros((nr, 128), jnp.int32)
+            ovf_p[...] = jnp.zeros((nr, 128), jnp.int32)
             peak_p[...] = init
-            wav_p[...] = jnp.zeros((8, 128), jnp.int32)
+            wav_p[...] = jnp.zeros((nr, 128), jnp.int32)
             sm[0] = 0
 
-        row16 = tab_ref[pl.ds(sub, 1), :]
+        row_t = tab_ref[pl.ds(sub, 1), :]
         shift = scal_ref[sub, S_SHIFT]
         ceilb = scal_ref[sub, S_CEILB]
-        upd = scal_ref[sub, S_UPD]
+        upd0 = scal_ref[sub, S_UPD0]
+        upd1 = scal_ref[sub, S_UPD1]
         R = scal_ref[sub, S_R]
 
         @pl.when(sm[0] == 0)
         def _wave():
-            _wave_body(jnp, lax, pl, pltpu, row16, shift, ceilb, upd,
-                       kk, R, stw_p, stv_p, alive_p, xs, rs, acc_p,
-                       ovf_p, peak_p, wav_p)
+            _wave_body(jnp, lax, pl, pltpu, wk, row_t, shift, ceilb,
+                       upd0, upd1, kk, R, stw_p, stv_p, alive_p, xs,
+                       rs, acc_p, ovf_p, peak_p, wav_p)
 
         # frontier-death check: one vector->scalar sync every
         # DONE_EVERY waves lets dead/padding steps skip the body
@@ -512,35 +561,62 @@ def _make_kernel(batched: bool):
 
         @pl.when(kk == nprog - 1)
         def _emit():
-            out_ref[0:8, :] = acc_p[...]
-            out_ref[8:16, :] = ovf_p[...]
-            out_ref[16:24, :] = peak_p[...]
-            out_ref[24:32, :] = wav_p[...]
+            out_ref[0:8, :] = _fold8(jnp, pltpu, acc_p[...], nr)
+            out_ref[8:16, :] = _fold8(jnp, pltpu, ovf_p[...], nr)
+            out_ref[16:24, :] = _fold8(jnp, pltpu, peak_p[...], nr)
+            out_ref[24:32, :] = _fold8(jnp, pltpu, wav_p[...], nr)
 
     return kernel
 
 
+def _fold8(jnp, pltpu, plane, nr: int):
+    """Fold an (nr, 128) flag plane into (8, 128) by maximum — the out
+    block stays (32, 128) for every window width."""
+    if nr == 8:
+        return plane
+    out = plane[0:8, :]
+    for b in range(1, nr // 8):
+        out = jnp.maximum(out, plane[8 * b:8 * b + 8, :])
+    return out
+
+
+def _scratch_shapes(wk: int):
+    from jax.experimental.pallas import tpu as pltpu
+    import jax.numpy as jnp
+    nw, nr, np_, segk, pl_n, tlanes = _dims(wk)
+    return [
+        pltpu.VMEM((nw * nr, 128), jnp.int32),   # stw_p (mask words)
+        pltpu.VMEM((nr, 128), jnp.int32),        # stv_p
+        pltpu.VMEM((nr, 128), jnp.int32),        # alive_p
+        pltpu.VMEM((nr * pl_n, 128), jnp.int32),  # xs (limb stack)
+        pltpu.VMEM((nr, 128), jnp.int32),        # rs (ranks)
+        pltpu.VMEM((nr, 128), jnp.int32),        # acc_p
+        pltpu.VMEM((nr, 128), jnp.int32),        # ovf_p
+        pltpu.VMEM((nr, 128), jnp.int32),        # peak_p
+        pltpu.VMEM((nr, 128), jnp.int32),        # wav_p
+        pltpu.SMEM((8,), jnp.int32),
+    ]
+
+
 @functools.lru_cache(maxsize=None)
-def _call_single(r_pad: int, interpret: bool):
+def _call_single(r_pad: int, wk: int, interpret: bool):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    nw, nr, np_, segk, pl_n, tlanes = _dims(wk)
     call = pl.pallas_call(
-        _make_kernel(False),
+        _make_kernel(False, wk),
         grid=(r_pad,),
         in_specs=[
-            pl.BlockSpec((TSUB, TLANES), lambda k: (k // TSUB, 0)),
-            pl.BlockSpec((TSUB, 4), lambda k: (k // TSUB, 0),
+            pl.BlockSpec((TSUB, tlanes), lambda k: (k // TSUB, 0)),
+            pl.BlockSpec((TSUB, SCAL_COLS), lambda k: (k // TSUB, 0),
                          memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((32, 128), lambda k: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((32, 128), jnp.int32),
-        scratch_shapes=[pltpu.VMEM((8, 128), jnp.int32)] * 3 +
-                       [pltpu.VMEM((8 * PL, 128), jnp.int32)] +
-                       [pltpu.VMEM((8, 128), jnp.int32)] * 5 +
-                       [pltpu.SMEM((8,), jnp.int32)],
+        scratch_shapes=_scratch_shapes(wk),
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
@@ -548,34 +624,33 @@ def _call_single(r_pad: int, interpret: bool):
 
     def run(i32, u16):
         from jax import lax
-        tab, scal = _build_tables_one(jnp, lax, i32, u16, r_pad)
+        tab, scal = _build_tables_one(jnp, lax, i32, u16, r_pad, wk)
         return call(tab, scal)
 
     return jax.jit(run)
 
 
 @functools.lru_cache(maxsize=None)
-def _call_batch(k_keys: int, r_pad: int, interpret: bool):
+def _call_batch(k_keys: int, r_pad: int, wk: int, interpret: bool):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    nw, nr, np_, segk, pl_n, tlanes = _dims(wk)
     call = pl.pallas_call(
-        _make_kernel(True),
+        _make_kernel(True, wk),
         grid=(k_keys, r_pad),
         in_specs=[
-            pl.BlockSpec((None, TSUB, TLANES),
+            pl.BlockSpec((None, TSUB, tlanes),
                          lambda key, k: (key, k // TSUB, 0)),
-            pl.BlockSpec((None, TSUB, 4), lambda key, k: (key, k // TSUB, 0),
+            pl.BlockSpec((None, TSUB, SCAL_COLS),
+                         lambda key, k: (key, k // TSUB, 0),
                          memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((None, 32, 128), lambda key, k: (key, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((k_keys, 32, 128), jnp.int32),
-        scratch_shapes=[pltpu.VMEM((8, 128), jnp.int32)] * 3 +
-                       [pltpu.VMEM((8 * PL, 128), jnp.int32)] +
-                       [pltpu.VMEM((8, 128), jnp.int32)] * 5 +
-                       [pltpu.SMEM((8,), jnp.int32)],
+        scratch_shapes=_scratch_shapes(wk),
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
@@ -583,15 +658,16 @@ def _call_batch(k_keys: int, r_pad: int, interpret: bool):
 
     # inputs are compact per-op arrays shipped 2D (the tunnel moves 3D
     # arrays pathologically slowly); frames build on device — one
-    # lax.map step per key bounds the (r_pad, W, W) pred-bit
-    # intermediates to ~1 MB each
+    # lax.map step per key bounds the (r_pad, wk, wk) pred-bit
+    # intermediates to ~1-4 MB each
     def run(i32_2d, u16_2d):
         from jax import lax
         i32r = i32_2d.reshape(k_keys, r_pad, 4)
         u16r = u16_2d.reshape(k_keys, r_pad, 12)
 
         def one(args):
-            return _build_tables_one(jnp, lax, args[0], args[1], r_pad)
+            return _build_tables_one(jnp, lax, args[0], args[1],
+                                     r_pad, wk)
 
         tabs, scals = lax.map(one, (i32r, u16r))
         return call(tabs, scals)
@@ -630,17 +706,17 @@ def check_packed_mxu(p: Packed) -> dict | None:
     r_pad = max(bucket(p.R), TSUB)
     i32, u16 = pack_perop(p, r_pad)
     interpret = jax.default_backend() != "tpu"
-    out = np.asarray(_call_single(r_pad, interpret)(
+    out = np.asarray(_call_single(r_pad, p.w, interpret)(
         jnp.asarray(i32), jnp.asarray(u16)))
     return _decode(out, p)
 
 
 def check_packed_batch_mxu(packs: list) -> list | None:
-    """Check many packed histories in ONE pallas dispatch per R-bucket
-    group. Returns per-pack results aligned with input order; packs the
-    kernel can't take (wide window, info ops, id overflow) get None
-    entries for the caller's per-key fallback. Returns None outright
-    when NO pack is supported."""
+    """Check many packed histories in ONE pallas dispatch per
+    (R-bucket, window-width) group. Returns per-pack results aligned
+    with input order; packs the kernel can't take (wide window, info
+    ops, id overflow) get None entries for the caller's per-key
+    fallback. Returns None outright when NO pack is supported."""
     import jax
     import jax.numpy as jnp
 
@@ -651,8 +727,8 @@ def check_packed_batch_mxu(packs: list) -> list | None:
     groups: dict = {}
     for i, p in enumerate(packs):
         if supported(p):
-            groups.setdefault(max(bucket(p.R), TSUB), []).append(i)
-    for r_pad, idxs in groups.items():
+            groups.setdefault((max(bucket(p.R), TSUB), p.w), []).append(i)
+    for (r_pad, wk), idxs in groups.items():
         # bucket the key count so the jit cache holds O(log K) variants
         # instead of one compile per distinct batch size; padding keys
         # are all-zero (R=0) rows whose grid steps die immediately
@@ -666,7 +742,7 @@ def check_packed_batch_mxu(packs: list) -> list | None:
             a, b = pack_perop(packs[i], r_pad)
             i32s[j] = a
             u16s[j] = b
-        out = np.asarray(_call_batch(k_pad, r_pad, interpret)(
+        out = np.asarray(_call_batch(k_pad, r_pad, wk, interpret)(
             jnp.asarray(i32s.reshape(k_pad * r_pad, 4)),
             jnp.asarray(u16s.reshape(k_pad * r_pad, 12))))
         for j, i in enumerate(idxs):

@@ -81,8 +81,8 @@ def test_device_table_builder_matches_host_packer():
         r_pad = max(wgl.bucket(p.R), wgl_mxu.TSUB)
         t_host, s_host = wgl_mxu.pack_tables(p, r_pad)
         i32, u16 = wgl_mxu.pack_perop(p, r_pad)
-        build = jax.jit(lambda a, b, rp=r_pad:
-                        wgl_mxu._build_tables_one(jnp, lax, a, b, rp))
+        build = jax.jit(lambda a, b, rp=r_pad, wk=p.w:
+                        wgl_mxu._build_tables_one(jnp, lax, a, b, rp, wk))
         t_dev, s_dev = [np.asarray(x)
                         for x in build(jnp.asarray(i32), jnp.asarray(u16))]
         assert (t_dev == t_host).all(), f"trial {trial}: table mismatch"
