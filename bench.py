@@ -6,10 +6,15 @@ linearizable on TPU; the reference's CPU Knossos cannot verify it within
 60 s (BASELINE.md "North star"), so vs_baseline = 60s / wall-clock.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"matrix": {...}} — the matrix carries BASELINE.md's other configs
-(register-100 CPU-vs-TPU, deep WGL at 4n/2000, set-full, Elle append at
-device-closure scale, watch edit-distance), each with wall-clock and
-search stats (peak frontier, spill, device usage).
+"matrix": {...}}. Every cell that simulates a history records its
+generation time separately (``gen_s``) — generation is checker-input
+prep, not the thing benchmarked. Device cells carry a cost split
+(``host_prep_ms`` / ``device_ms`` / end-to-end) because on this
+environment (v5e through the axon tunnel) a synchronized device call
+pays ~100 ms round-trip latency regardless of work — see PERF.md.
+The engine-crossover cell measures the native DFS against the MXU wave
+kernel head-to-head on shared histories; the routing constants in
+checkers/tpu_linearizable.py cite its numbers.
 """
 
 import json
@@ -23,19 +28,22 @@ CONCURRENCY = 8
 BASELINE_SECONDS = 60.0  # CPU Knossos budget it cannot meet
 
 
-def sim_register_history(n_ops, concurrency, seed=2026, name="bench",
-                         nodes=None):
-    """n_ops on ONE key via the simulated cluster (fast: virtual time)."""
+def _sim_keys(keys, ops_per_key, concurrency, seed, name, nodes=None):
+    """Simulated register histories for a key list (virtual time).
+    Returns ({key: History}, gen_s, total_ops) — the ONE scaffolding
+    both the single-key and batched cells build on."""
     from jepsen_etcd_tpu.compose import etcd_test
     from jepsen_etcd_tpu.runner.test_runner import run_test
     from jepsen_etcd_tpu.generators import limit, mix, reserve, independent
+    from jepsen_etcd_tpu.generators.independent import subhistory
+    from jepsen_etcd_tpu.core.history import History
     from jepsen_etcd_tpu.workloads.register import (RegisterClient, r, w,
                                                     cas)
     from jepsen_etcd_tpu.checkers.core import Noop
 
     test = etcd_test({
         "workload": "none",
-        "time_limit": 3600, "rate": 0, "seed": seed,
+        "time_limit": 36_000, "rate": 0, "seed": seed,
         "concurrency": concurrency, "store_base": "store",
         **({"nodes": nodes} if nodes else {}),
         # generation is checker-input prep, not the thing benchmarked:
@@ -48,12 +56,21 @@ def sim_register_history(n_ops, concurrency, seed=2026, name="bench",
     test["client"] = RegisterClient()
     test["checker"] = Noop()
     test["generator"] = independent.concurrent_generator(
-        concurrency, [0],
-        lambda k: limit(n_ops, reserve(concurrency // 2, r, mix([w, cas]))))
+        concurrency, list(keys),
+        lambda k: limit(ops_per_key, reserve(concurrency // 2, r,
+                                             mix([w, cas]))))
+    t0 = time.time()
     out = run_test(test)
-    from jepsen_etcd_tpu.generators.independent import subhistory
-    from jepsen_etcd_tpu.core.history import History
-    return History(subhistory(out["history"], 0))
+    gen_s = time.time() - t0
+    subs = {k: History(subhistory(out["history"], k)) for k in keys}
+    return subs, gen_s, len(out["history"])
+
+
+def sim_register_history(n_ops, concurrency, seed=2026, name="bench",
+                         nodes=None):
+    """n_ops on ONE key via the simulated cluster (fast: virtual time)."""
+    subs, _, _ = _sim_keys([0], n_ops, concurrency, seed, name, nodes)
+    return subs[0]
 
 
 def run_workload(workload, seed=7, time_limit=40, rate=200, **opts):
@@ -63,29 +80,60 @@ def run_workload(workload, seed=7, time_limit=40, rate=200, **opts):
          "seed": seed, "store_base": "store"}
     o.update(opts)
     test = etcd_test(o)
-    return test, run_test(test)
+    t0 = time.time()
+    out = run_test(test)
+    return test, out, time.time() - t0
 
 
 def note(msg):
     print(f"# {msg}", file=sys.stderr)
 
 
+def gen_batched_keys(K, concurrency, per_key, seed):
+    return _sim_keys(range(K), per_key, concurrency, seed,
+                     f"bench-batched-{K}", nodes=["n1", "n2", "n3"])
+
+
 def bench_register_10k():
-    """North star: 10k-op single-key check (config #1's big sibling)."""
-    from jepsen_etcd_tpu.ops import wgl
+    """North star: 10k-op single-key check with the full device cost
+    split (host table prep / one-dispatch end-to-end / device-resident
+    re-run)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jepsen_etcd_tpu.ops import wgl, wgl_mxu
     t0 = time.time()
     h = sim_register_history(N_OPS, CONCURRENCY, name="bench-register-10k")
-    note(f"10k: generated {len(h)} ops in {time.time()-t0:.1f}s")
+    gen_s = time.time() - t0
+    note(f"10k: generated {len(h)} ops in {gen_s:.1f}s")
     p = wgl.pack_register_history(h)
     assert p.ok, p.reason
     wgl.check_packed(p)  # warmup: compile + first search
     t1 = time.time()
     out = wgl.check_packed(p)
     dt = time.time() - t1
+    # cost split: host per-op packing; device-resident exec (tables
+    # already shipped) isolates tunnel transfer+latency from compute
+    r_pad = max(wgl.bucket(p.R), wgl_mxu.TSUB)
+    t1 = time.time()
+    i32, u16 = wgl_mxu.pack_perop(p, r_pad)
+    prep_ms = (time.time() - t1) * 1e3
+    dev = [jax.device_put(jnp.asarray(x)) for x in (i32, u16)]
+    jax.block_until_ready(dev)
+    call = wgl_mxu._call_single(r_pad, p.w,
+                                jax.default_backend() != "tpu")
+    np.asarray(call(*dev))
+    best = 1e9
+    for _ in range(3):
+        t1 = time.time()
+        np.asarray(call(*dev))
+        best = min(best, time.time() - t1)
     note(f"10k: verdict={out['valid?']} waves={out.get('waves')} "
-         f"peak={out.get('peak-frontier')} w={p.w} in {dt:.3f}s")
+         f"engine={out.get('engine')} peak={out.get('peak-frontier')} "
+         f"w={p.w} in {dt:.3f}s (prep {prep_ms:.0f}ms, device-resident "
+         f"{best*1e3:.0f}ms)")
     assert out["valid?"] is True, out
-    return dt, out, p
+    return dt, out, p, gen_s, prep_ms, best * 1e3
 
 
 def bench_register_100():
@@ -94,8 +142,10 @@ def bench_register_100():
     from jepsen_etcd_tpu.ops import wgl
     from jepsen_etcd_tpu.checkers.linearizable import check_history
     from jepsen_etcd_tpu.models import VersionedRegister
+    t0 = time.time()
     h = sim_register_history(135, CONCURRENCY, seed=11,
                              name="bench-register-100")
+    gen_s = time.time() - t0
     p = wgl.pack_register_history(h)
     assert p.ok, p.reason
     t0 = time.time()
@@ -123,7 +173,7 @@ def bench_register_100():
     note(f"100-op: cpu={cpu_s:.4f}s native={native_s:.4f}s "
          f"tpu={tpu_s:.4f}s production={prod_s:.4f}s "
          f"({pres['checker']})")
-    return {"value": round(prod_s, 4), "unit": "s",
+    return {"value": round(prod_s, 4), "unit": "s", "gen_s": round(gen_s, 2),
             "cpu_oracle_s": round(cpu_s, 4),
             "native_oracle_s": round(native_s, 4),
             "tpu_kernel_s": round(tpu_s, 4),
@@ -132,11 +182,92 @@ def bench_register_100():
                 prod_s, 1e-9), 1)}
 
 
+def bench_engine_crossover():
+    """VERDICT r3 #3: the DFS<->kernel crossover MEASURED, not modeled.
+    One 50k generation; prefixes at completion boundaries give the
+    sweep sizes. The adversarial row injects a violation mid-history,
+    where a backtracking DFS must linearize half the history before
+    discovering it. DFS_FIRST_MAX in checkers/tpu_linearizable.py is
+    calibrated from this table."""
+    from jepsen_etcd_tpu.core.op import Op
+    from jepsen_etcd_tpu.core.history import History
+    from jepsen_etcd_tpu.ops import wgl, wgl_mxu
+    from jepsen_etcd_tpu.checkers.linearizable import check_history
+    from jepsen_etcd_tpu.models import VersionedRegister
+    from jepsen_etcd_tpu.native import get_lib
+    get_lib()
+    gen_s = 0.0
+    rows = []
+    h = None
+    for n_req in (3_375, 13_500, 33_750):
+        t0 = time.time()
+        hh = sim_register_history(n_req, CONCURRENCY, seed=17,
+                                  name=f"bench-crossover-{n_req}",
+                                  nodes=["n1", "n2", "n3"])
+        gen_s += time.time() - t0
+        h = hh  # largest kept for the adversarial row
+        p = wgl.pack_register_history(hh)
+        if not (p.ok and wgl_mxu.supported(p)):
+            continue
+        t1 = time.time()
+        nat = check_history(VersionedRegister(), hh)
+        nat_s = time.time() - t1
+        wgl_mxu.check_packed_mxu(p)  # warmup this bucket
+        t1 = time.time()
+        mxu = wgl_mxu.check_packed_mxu(p)
+        mxu_s = time.time() - t1
+        rows.append({"entries": len(hh), "R": p.R,
+                     "native_s": round(nat_s, 4),
+                     "mxu_s": round(mxu_s, 4),
+                     "native_valid": nat["valid?"],
+                     "mxu_valid": mxu["valid?"]})
+        note(f"crossover entries={len(hh)}: native={nat_s:.3f}s "
+             f"mxu={mxu_s:.3f}s")
+    # adversarial: violation at the midpoint of the largest history
+    ops = list(h)
+    mid = len(ops) // 2
+    adv = [Op(dict(o)) for o in ops]
+    for i in range(mid, len(adv)):
+        o = adv[i]
+        if o.get("type") == "ok" and o.get("f") == "read" \
+                and o.get("value") and o["value"][1] is not None:
+            v = list(o["value"])
+            v[1] = 424242
+            adv[i]["value"] = v
+            break
+    ha = History(adv)
+    pa = wgl.pack_register_history(ha)
+    t1 = time.time()
+    nat = check_history(VersionedRegister(), ha, max_configs=5_000_000)
+    nat_s = time.time() - t1
+    t1 = time.time()
+    mxu = wgl_mxu.check_packed_mxu(pa)
+    mxu_s = time.time() - t1
+    note(f"crossover adversarial: native={nat_s:.3f}s ({nat['valid?']}) "
+         f"mxu={mxu_s:.3f}s ({mxu['valid?']})")
+    adv_row = {"entries": len(ha), "native_s": round(nat_s, 4),
+               "mxu_s": round(mxu_s, 4), "both_false":
+               nat["valid?"] is False and mxu["valid?"] is False}
+    # value = the largest measured speedup row (kernel vs native)
+    if rows:
+        full = max(rows, key=lambda r: r["entries"])
+        val = round(full["native_s"] / max(full["mxu_s"], 1e-9), 1)
+        unit = f"x_native_at_{full['entries']}_entries"
+    else:
+        val, unit = 0.0, "no_supported_rows"
+    return {"value": val, "unit": unit,
+            "gen_s": round(gen_s, 2), "table": rows,
+            "adversarial": adv_row,
+            "vs_baseline": val}
+
+
 def bench_deep_wgl():
     """Config #2: concurrency 4n (=20), ops-per-key 2000 — deep
     permutation search; records peak frontier + spill stats."""
     from jepsen_etcd_tpu.ops import wgl
+    t0 = time.time()
     h = sim_register_history(2600, 20, seed=5, name="bench-register-deep")
+    gen_s = time.time() - t0
     p = wgl.pack_register_history(h)
     assert p.ok, p.reason
     # deep searches overflow the 128 rung immediately; start at 512 to
@@ -149,52 +280,35 @@ def bench_deep_wgl():
          f"peak={out.get('peak-frontier')} spilled={out.get('spilled')} "
          f"in {dt:.3f}s")
     assert out["valid?"] is True, out
-    return {"value": round(dt, 4), "unit": "s", "ops": p.R, "w": p.w,
+    return {"value": round(dt, 4), "unit": "s", "gen_s": round(gen_s, 2),
+            "ops": p.R, "w": p.w,
             "peak_frontier": out.get("peak-frontier"),
             "spilled": bool(out.get("spilled")),
             "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
 
 
 def bench_batched_keys():
-    """The production key-DP axis (SURVEY §2.3): 64 independent keys
-    packed into vmapped kernel launches, key axis sharded over the
-    device mesh. One sim run generates all keys' histories; the timed
-    region is the whole batched check."""
-    from jepsen_etcd_tpu.compose import etcd_test
-    from jepsen_etcd_tpu.runner.test_runner import run_test
-    from jepsen_etcd_tpu.generators import limit, mix, reserve, independent
-    from jepsen_etcd_tpu.generators.independent import subhistory
-    from jepsen_etcd_tpu.core.history import History
-    from jepsen_etcd_tpu.workloads.register import RegisterClient, r, w, cas
-    from jepsen_etcd_tpu.checkers.core import Noop
-    from jepsen_etcd_tpu.ops import wgl
-
-    K = 64
-    test = etcd_test({"workload": "none", "time_limit": 3600, "rate": 0,
-                      "seed": 3, "concurrency": 8, "store_base": "store",
-                      "snapshot_count": 100_000})
-    test["name"] = "bench-batched-keys"
-    test["client"] = RegisterClient()
-    test["checker"] = Noop()
-    test["generator"] = independent.concurrent_generator(
-        8, list(range(K)),
-        lambda k: limit(200, reserve(4, r, mix([w, cas]))))
-    out = run_test(test)
-    subs = {k: History(subhistory(out["history"], k)) for k in range(K)}
-    packs = [wgl.pack_register_history(subs[k]) for k in range(K)]
-    ok_packs = [p for p in packs if p.ok]
-    wgl.check_packed_batch(packs)  # warmup compiles
-    t0 = time.time()
-    results = wgl.check_packed_batch(packs)
-    dt = time.time() - t0
-    valid = sum(1 for res in results if res.get("valid?") is True)
-    note(f"batched {K} keys (kernel): {valid} valid, {len(ok_packs)} "
-         f"packed, in {dt:.3f}s ({K/max(dt,1e-9):.0f} keys/s)")
-    assert valid == K, results
-    # production path: check_batch's size cutoff answers keys this small
-    # from the native DFS without any device dispatch
+    """The key-DP axis (SURVEY §2.3) at 64 keys. kernel_s is the MXU
+    batch — ONE pallas dispatch for the whole batch; production_s is
+    the checker's routed path. The router keeps the native sweep in
+    production here BY MEASUREMENT: the tunnel's ~0.1 s round trip
+    alone exceeds the native sweep for keys this small (PERF.md)."""
+    from jepsen_etcd_tpu.ops import wgl, wgl_mxu
     from jepsen_etcd_tpu.checkers.tpu_linearizable import (
         TPULinearizableChecker)
+    K = 64
+    subs, gen_s, total_ops = gen_batched_keys(K, 8, 200, seed=3)
+    note(f"batched {K}: generated {total_ops} ops in {gen_s:.1f}s")
+    packs = [wgl.pack_register_history(subs[k]) for k in range(K)]
+    wgl_mxu.check_packed_batch_mxu(packs)  # warmup compiles
+    t0 = time.time()
+    results = wgl_mxu.check_packed_batch_mxu(packs)
+    kernel_s = time.time() - t0
+    valid = sum(1 for res in results
+                if res is not None and res.get("valid?") is True)
+    note(f"batched {K} (mxu one-dispatch): {valid} valid in "
+         f"{kernel_s:.3f}s ({K/max(kernel_s,1e-9):.0f} keys/s)")
+    assert valid == K, results
     prod = TPULinearizableChecker()
     t0 = time.time()
     pres = prod.check_batch({}, subs)
@@ -203,27 +317,24 @@ def bench_batched_keys():
     for r in pres.values():
         engines[r.get("checker")] = engines.get(r.get("checker"), 0) + 1
     assert all(r["valid?"] is True for r in pres.values())
-    note(f"batched {K} keys (production): engines={engines} "
-         f"in {prod_s:.3f}s")
-    # headline value pins the PRODUCTION engine (matching
-    # bench_register_100); kernel_s tracks the device path separately
-    # so a regression in either series stays visible
-    return {"value": round(prod_s, 4), "unit": "s", "keys": K,
-            "kernel_s": round(dt, 4), "production_s": round(prod_s, 4),
-            "engines": engines,
+    note(f"batched {K} (production): engines={engines} in {prod_s:.3f}s")
+    return {"value": round(prod_s, 4), "unit": "s",
+            "gen_s": round(gen_s, 2), "keys": K,
+            "kernel_s": round(kernel_s, 4),
+            "production_s": round(prod_s, 4), "engines": engines,
             "keys_per_s": round(K / max(prod_s, 1e-9), 1),
             "vs_baseline": round(BASELINE_SECONDS / max(prod_s, 1e-9), 1)}
 
 
 def bench_register_50k():
-    """Scale cell (VERDICT r3 #7): >=50k-op single-key history — 5x the
-    north star — recording where the ladder/spill boundaries land."""
+    """Scale cell: >=50k-op single-key history — 5x the north star."""
     from jepsen_etcd_tpu.ops import wgl
     t0 = time.time()
     h = sim_register_history(67_500, CONCURRENCY, seed=17,
                              name="bench-register-50k",
                              nodes=["n1", "n2", "n3"])
-    note(f"50k: generated {len(h)} ops in {time.time()-t0:.1f}s")
+    gen_s = time.time() - t0
+    note(f"50k: generated {len(h)} ops in {gen_s:.1f}s")
     p = wgl.pack_register_history(h)
     assert p.ok, p.reason
     wgl.check_packed(p)  # warmup: compile + first search
@@ -231,65 +342,45 @@ def bench_register_50k():
     out = wgl.check_packed(p)
     dt = time.time() - t1
     note(f"50k: verdict={out['valid?']} waves={out.get('waves')} "
-         f"peak={out.get('peak-frontier')} w={p.w} "
-         f"spilled={out.get('spilled')} in {dt:.3f}s")
+         f"engine={out.get('engine')} peak={out.get('peak-frontier')} "
+         f"w={p.w} in {dt:.3f}s")
     assert out["valid?"] is True, out
-    return {"value": round(dt, 4), "unit": "s", "ops": p.R, "w": p.w,
-            "waves": out.get("waves"),
+    return {"value": round(dt, 4), "unit": "s", "gen_s": round(gen_s, 2),
+            "ops": p.R, "w": p.w, "waves": out.get("waves"),
+            "engine": out.get("engine"),
             "peak_frontier": out.get("peak-frontier"),
-            "spilled": bool(out.get("spilled")),
             "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
 
 
 def bench_batched_512_keys():
-    """Scale cell (VERDICT r3 #7): 512 independent keys in vmapped
-    kernel launches, key axis sharded over the device mesh — the key-DP
-    axis at 8x the round-2 batch."""
-    from jepsen_etcd_tpu.compose import etcd_test
-    from jepsen_etcd_tpu.runner.test_runner import run_test
-    from jepsen_etcd_tpu.generators import limit, mix, reserve, independent
-    from jepsen_etcd_tpu.generators.independent import subhistory
-    from jepsen_etcd_tpu.core.history import History
-    from jepsen_etcd_tpu.workloads.register import RegisterClient, r, w, cas
-    from jepsen_etcd_tpu.checkers.core import Noop
-    from jepsen_etcd_tpu.ops import wgl
-
-    K = 512
-    t0 = time.time()
-    # 3 nodes: replication fan-out dominates generation wall-clock and
-    # the checker input doesn't care about cluster size
-    test = etcd_test({"workload": "none", "time_limit": 36_000, "rate": 0,
-                      "seed": 29, "concurrency": 16, "store_base": "store",
-                      "nodes": ["n1", "n2", "n3"],
-                      "snapshot_count": 100_000})
-    test["name"] = "bench-batched-512"
-    test["client"] = RegisterClient()
-    test["checker"] = Noop()
-    test["generator"] = independent.concurrent_generator(
-        16, list(range(K)),
-        lambda k: limit(100, reserve(8, r, mix([w, cas]))))
-    out = run_test(test)
-    subs = {k: History(subhistory(out["history"], k)) for k in range(K)}
-    note(f"512-key: generated {len(out['history'])} ops "
-         f"in {time.time()-t0:.1f}s")
-    packs = [wgl.pack_register_history(subs[k]) for k in range(K)]
-    assert all(p.ok for p in packs), [p.reason for p in packs if not p.ok]
-    wgl.check_packed_batch(packs)  # warmup compiles
-    t1 = time.time()
-    results = wgl.check_packed_batch(packs)
-    kernel_s = time.time() - t1
-    valid = sum(1 for res in results if res.get("valid?") is True)
-    assert valid == K, f"only {valid}/{K} valid"
-    # production path (size cutoff routes these to the native engine)
+    """Scale cell: 512 independent keys (concurrency 16 -> w=64
+    windows for most keys, exercising the two-word kernel). kernel_s =
+    one MXU dispatch per (bucket, width) group."""
+    from jepsen_etcd_tpu.ops import wgl, wgl_mxu
     from jepsen_etcd_tpu.checkers.tpu_linearizable import (
         TPULinearizableChecker)
+    K = 512
+    subs, gen_s, total_ops = gen_batched_keys(K, 16, 100, seed=29)
+    note(f"512-key: generated {total_ops} ops in {gen_s:.1f}s")
+    packs = [wgl.pack_register_history(subs[k]) for k in range(K)]
+    widths = {}
+    for p in packs:
+        widths[p.w] = widths.get(p.w, 0) + 1
+    wgl_mxu.check_packed_batch_mxu(packs)  # warmup compiles
+    t1 = time.time()
+    results = wgl_mxu.check_packed_batch_mxu(packs)
+    kernel_s = time.time() - t1
+    valid = sum(1 for res in results
+                if res is not None and res.get("valid?") is True)
+    assert valid == K, f"only {valid}/{K} valid"
     t1 = time.time()
     pres = TPULinearizableChecker().check_batch({}, subs)
     prod_s = time.time() - t1
     assert all(res["valid?"] is True for res in pres.values())
     note(f"512-key: kernel={kernel_s:.3f}s production={prod_s:.3f}s "
-         f"({K/max(prod_s,1e-9):.0f} keys/s)")
-    return {"value": round(prod_s, 4), "unit": "s", "keys": K,
+         f"widths={widths} ({K/max(prod_s,1e-9):.0f} keys/s)")
+    return {"value": round(prod_s, 4), "unit": "s",
+            "gen_s": round(gen_s, 2), "keys": K, "widths": widths,
             "kernel_s": round(kernel_s, 4),
             "production_s": round(prod_s, 4),
             "keys_per_s": round(K / max(prod_s, 1e-9), 1),
@@ -299,12 +390,11 @@ def bench_batched_512_keys():
 def bench_faulted_register():
     """Register under kill+partition faults: histories carry :info
     (crashed) ops — the regime the info-op packing, symmetry classes,
-    and version-ceiling prune exist for. Times the full independent-key
-    checker pass and reports how many keys stayed on the TPU path."""
+    and version-ceiling prune exist for."""
     from jepsen_etcd_tpu.workloads.register import workload as reg_wl
-    test, out = run_workload("register", time_limit=40, rate=200,
-                             nemesis=["kill", "partition"],
-                             nemesis_interval=5.0)
+    test, out, gen_s = run_workload("register", time_limit=40, rate=200,
+                                    nemesis=["kill", "partition"],
+                                    nemesis_interval=5.0)
     h = out["history"]
     infos = len([o for o in h.client_ops() if o.is_info])
     checker = reg_wl({"nodes": test["nodes"]})["checker"]
@@ -322,51 +412,103 @@ def bench_faulted_register():
     note(f"faulted register: valid?={res['valid?']} infos={infos} "
          f"engines={engines} in {dt:.3f}s")
     assert res["valid?"] is True, res
-    return {"value": round(dt, 4), "unit": "s", "history_ops": len(h),
-            "info_ops": infos, "engines": engines,
+    return {"value": round(dt, 4), "unit": "s", "gen_s": round(gen_s, 2),
+            "history_ops": len(h), "info_ops": infos, "engines": engines,
             "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
 
 
 def bench_set():
     """Config #3: set workload — CAS-retry adds + set-full analysis."""
     from jepsen_etcd_tpu.checkers.set_full import SetFull
-    test, out = run_workload("set", time_limit=60, rate=200)
+    test, out, gen_s = run_workload("set", time_limit=60, rate=200)
     h = out["history"]
     t0 = time.time()
     res = SetFull(linearizable=True).check(test, h)
     dt = time.time() - t0
     note(f"set-full: valid?={res['valid?']} over {len(h)} ops in {dt:.3f}s")
     assert res["valid?"] is True, res
-    return {"value": round(dt, 4), "unit": "s", "history_ops": len(h),
+    return {"value": round(dt, 4), "unit": "s", "gen_s": round(gen_s, 2),
+            "history_ops": len(h),
             "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
 
 
 def bench_elle_append():
-    """Config #4: Elle list-append dep-graph + closure at device scale
-    (>=256 committed txns forces the device closure path)."""
+    """Config #4: Elle list-append dep-graph + closure, HOST vs DEVICE
+    closure timed head-to-head at the workload's real txn count (the
+    ops/closure.py CPU_CUTOFF=768 crossover cites these numbers)."""
     from jepsen_etcd_tpu.workloads.append import workload as append_wl
-    test, out = run_workload("append", time_limit=25, rate=200)
+    test, out, gen_s = run_workload("append", time_limit=25, rate=200)
     h = out["history"].client_ops()
     committed = len([o for o in h if o.is_ok])
     checker = append_wl({"nodes": test["nodes"]})["checker"]
-    checker.use_tpu = True  # force the device closure regardless of N
+    # device path (the size-router picks it anyway at this txn count —
+    # ops/closure.py CPU_CUTOFF=768)
+    checker.use_tpu = True
     checker.check(test, h)  # warmup: closure compile
     t0 = time.time()
     res = checker.check(test, h)
-    dt = time.time() - t0
+    dev_s = time.time() - t0
+    # host leg only at sizes where numpy finishes in bench time; the
+    # closure_scale_2048 cell carries the head-to-head at scale
+    host_s = None
+    if committed <= 2048:
+        checker.use_tpu = False
+        t0 = time.time()
+        res_h = checker.check(test, h)
+        host_s = time.time() - t0
+        assert res_h["valid?"] is True
     note(f"elle append: valid?={res['valid?']} txns={committed} "
-         f"in {dt:.3f}s (device closure forced)")
-    assert res["valid?"] is True, res
-    return {"value": round(dt, 4), "unit": "s", "committed_txns": committed,
-            "device_closure": True,
-            "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
+         f"device={dev_s:.3f}s host={host_s}")
+    assert res["valid?"] is True
+    return {"value": round(dev_s, 4), "unit": "s", "gen_s": round(gen_s, 2),
+            "committed_txns": committed,
+            "device_closure_s": round(dev_s, 4),
+            **({"host_closure_s": round(host_s, 4)}
+               if host_s is not None else
+               {"host_closure": "skipped (txns > 2048; see "
+                                "closure_scale_2048)"}),
+            "vs_baseline": round(BASELINE_SECONDS / max(dev_s, 1e-9), 1)}
+
+
+def bench_closure_scale():
+    """VERDICT r3 #5: a closure size where the MXU path decisively
+    beats numpy. Six 2048-node subgraphs (the append checker's shape
+    at ~30 min of workload), measured host vs device."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jepsen_etcd_tpu.ops import closure
+    rng = np.random.RandomState(0)
+    B, N = 6, 2048
+    a = rng.rand(B, N, N) < (2.0 / N)
+    iters = int(np.ceil(np.log2(N))) + 1
+    t0 = time.time()
+    for b in range(B):
+        r = a[b] | np.eye(N, dtype=bool)
+        for _ in range(iters):
+            r = (r.astype(np.float32) @ r.astype(np.float32)) > 0
+    host_s = time.time() - t0
+    f = closure._closure_device
+    np.asarray(f(jnp.asarray(a), iters)[0])  # warmup
+    best = 1e9
+    for _ in range(2):
+        t0 = time.time()
+        np.asarray(f(jnp.asarray(a), iters)[0])
+        best = min(best, time.time() - t0)
+    note(f"closure scale N={N}: host={host_s:.2f}s device={best:.2f}s "
+         f"({host_s/max(best,1e-9):.1f}x)")
+    return {"value": round(best, 4), "unit": "s", "nodes": N,
+            "subgraphs": B, "host_s": round(host_s, 4),
+            "device_s": round(best, 4),
+            "speedup_x": round(host_s / max(best, 1e-9), 1),
+            "vs_baseline": round(host_s / max(best, 1e-9), 1)}
 
 
 def bench_watch():
     """Config #5: watch per-thread log order vs canonical (TPU
     edit-distance)."""
     from jepsen_etcd_tpu.checkers.watch import WatchChecker
-    test, out = run_workload("watch", time_limit=60, rate=200)
+    test, out, gen_s = run_workload("watch", time_limit=60, rate=200)
     h = out["history"]
     checker = WatchChecker(use_tpu=True)
     checker.check(test, h)  # warmup: wavefront-DP compile
@@ -375,7 +517,8 @@ def bench_watch():
     dt = time.time() - t0
     note(f"watch: valid?={res['valid?']} in {dt:.3f}s")
     assert res["valid?"] in (True, "unknown"), res
-    return {"value": round(dt, 4), "unit": "s", "history_ops": len(h),
+    return {"value": round(dt, 4), "unit": "s", "gen_s": round(gen_s, 2),
+            "history_ops": len(h),
             "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
 
 
@@ -384,6 +527,7 @@ def main() -> int:
     enable_compile_cache()
     matrix = {}
     for name, fn in [("register_100", bench_register_100),
+                     ("engine_crossover", bench_engine_crossover),
                      ("deep_wgl_4n_2000", bench_deep_wgl),
                      ("faulted_register", bench_faulted_register),
                      ("batched_64_keys", bench_batched_keys),
@@ -391,6 +535,7 @@ def main() -> int:
                      ("batched_512_keys", bench_batched_512_keys),
                      ("set_full", bench_set),
                      ("elle_append_device", bench_elle_append),
+                     ("closure_scale_2048", bench_closure_scale),
                      ("watch_edit_distance", bench_watch)]:
         try:
             matrix[name] = fn()
@@ -398,11 +543,15 @@ def main() -> int:
             note(f"{name} FAILED: {e!r}")
             matrix[name] = {"error": repr(e)}
 
-    check_s, out, p = bench_register_10k()
+    check_s, out, p, gen_s, prep_ms, device_ms = bench_register_10k()
     print(json.dumps({
         "metric": "register_linearizability_10k_ops_check_wallclock",
         "value": round(check_s, 4),
         "unit": "s",
+        "gen_s": round(gen_s, 2),
+        "host_prep_ms": round(prep_ms, 1),
+        "device_ms": round(device_ms, 1),
+        "engine": out.get("engine"),
         "vs_baseline": round(BASELINE_SECONDS / max(check_s, 1e-9), 1),
         "matrix": matrix,
     }))

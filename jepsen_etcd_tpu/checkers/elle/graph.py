@@ -24,11 +24,14 @@ G-single cycle iff the *previous* level's closure already reaches b -> a
 
 from __future__ import annotations
 
+import logging
 import math
 from collections import defaultdict, deque
 from typing import Any, Optional
 
 import numpy as np
+
+logger = logging.getLogger("jepsen_etcd_tpu.checkers")
 
 from ...core.history import History
 from ...ops.closure import closure_batch_lazy
@@ -226,9 +229,15 @@ class DepGraph:
                 # bound the enumeration: a densely cyclic history can
                 # have O(E) on-cycle anchors (one BFS each) — Elle
                 # likewise bounds its cycle search rather than emit
-                # thousands of certificates
+                # thousands of certificates. Mark the truncation so a
+                # dense history's report never reads as exhaustive
+                # (the repo's no-silent-caps convention).
                 if len(found) >= MAX_CERTS_PER_CLASS or \
                         scans >= MAX_ANCHOR_SCANS:
+                    if found:
+                        found[-1] = dict(found[-1],
+                                         **{"certificates-truncated": True})
+                    truncated_classes.append(name)
                     break
                 if not reach[need][b, a]:
                     continue
@@ -251,6 +260,7 @@ class DepGraph:
             return found
 
         recs: list = []
+        truncated_classes: list = []
         add = recs.extend
 
         ww, wr, rw = self.edges[WW], self.edges[WR], self.edges[RW]
@@ -274,6 +284,13 @@ class DepGraph:
                 add(anchored("G-single-realtime", rw, need=4, forbid=(1,)))
                 add(anchored("G2-item-realtime", rw, need=5,
                              forbid=(2, 4)))
+        if truncated_classes:
+            logger.warning(
+                "elle certificate enumeration truncated for %s "
+                "(caps: %d certificates / %d anchor scans per class); "
+                "the verdict is unaffected but the anomaly list is "
+                "not exhaustive", truncated_classes,
+                MAX_CERTS_PER_CLASS, MAX_ANCHOR_SCANS)
         return recs
 
     def _record(self, name: str, cycle: list) -> dict:

@@ -28,16 +28,25 @@ logger = logging.getLogger("jepsen_etcd_tpu.checkers")
 #: register.clj:110-112 (one checker, engine picked by problem size).
 CPU_CUTOFF = 512
 
-#: mid-size band: up to here the DFS still gets first shot, but with a
-#: budget scaled to history size instead of the flat 1M cheap-shot cap.
-#: Measured (single v5e + this host): DFS witness search is ~R configs
-#: x O(n) entry scan ~= 1.5 ns per config-entry, so a valid 16k-entry
-#: history answers in ~0.2 s where the kernel pays ~0.3 s dispatch +
-#: 116 us/op ~= 1.2 s; past ~70k entries the DFS's quadratic term loses
-#: to the kernel's linear wave count. 16384 caps the worst case (budget
-#: exhausted on an adversarial history, then the kernel runs anyway) at
-#: roughly one kernel-run's worth of wasted time.
-DFS_FIRST_MAX = 16_384
+#: mid-size band: up to here the DFS still gets first shot, with a
+#: budget scaled to history size. MEASURED head-to-head (r4, single
+#: v5e through axon, native DFS vs the MXU wave kernel on register
+#: histories; entries = history length incl invokes ~= 2.6 x R):
+#:
+#:   R      entries   native DFS   mxu kernel
+#:   511     1,350      0.005 s      0.079 s
+#:   2,068   5,400      0.027 s      0.118 s
+#:   5,157  13,500      0.101 s      0.102 s   <- crossover
+#:   10,392 27,000      0.599 s      0.129 s
+#:   26,045 67,500      3.004 s      0.223 s
+#:   52,007 135,000     8.766 s      0.398 s
+#:
+#: adversarial (violation injected mid-history, DFS must linearize
+#: half before discovering it): R=10,392 native 0.332 s vs mxu
+#: 0.135 s — same crossover region, so one constant serves both.
+#: The kernel's floor is the axon tunnel round trip (~0.1 s); the
+#: DFS's curve is ~quadratic. They cross at ~13k entries.
+DFS_FIRST_MAX = 13_000
 
 
 class TPULinearizableChecker(Checker):

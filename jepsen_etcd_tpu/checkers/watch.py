@@ -121,18 +121,59 @@ class WatchChecker(Checker):
         # a gapped thread may omit exactly the values that fell inside a
         # recorded compaction window — everything it DID see must still
         # be in canonical order, and every canonical value it missed
-        # must be attributable to a gap
-        value_rev = {}
+        # must be attributable to a gap. Attribution is by OCCURRENCE
+        # (value, revision), not a first-seen value->rev map: were the
+        # same value ever written twice, a miss of the later occurrence
+        # must be judged against ITS revision, not the earlier one's.
+        from collections import Counter, defaultdict
+        value_revs: dict = defaultdict(set)
         for t in logs:
             for v, r in zip(logs[t], revs.get(t, [])):
-                value_rev.setdefault(v, r)
+                value_revs[v].add(r)
+        sorted_revs = {v: sorted(rs) for v, rs in value_revs.items()}
+
+        def canonical_occurrence_revs():
+            nth: Counter = Counter()
+            out = []
+            for v in canonical:
+                rl = sorted_revs.get(v)
+                k = nth[v]
+                nth[v] += 1
+                out.append(rl[min(k, len(rl) - 1)] if rl else None)
+            return out
+
+        crevs = canonical_occurrence_revs()
         for thread in gapped:
-            seen = set(logs[thread])
-            missing = [v for v in canonical if v not in seen]
+            trevs = revs.get(thread, [])
+            missing_pairs = []
+            if len(trevs) == len(logs[thread]):
+                # match by the thread's OWN recorded (value, revision)
+                # pairs: a thread that saw only the LATER of two writes
+                # of the same value must have the EARLIER occurrence
+                # marked missing (attributable to its gap), not the
+                # later one
+                avail: Counter = Counter(zip(logs[thread], trevs))
+                for v, r in zip(canonical, crevs):
+                    if avail[(v, r)] > 0:
+                        avail[(v, r)] -= 1
+                    else:
+                        missing_pairs.append((v, r))
+            else:
+                # no per-event revisions recorded: greedy value-count
+                # matching (exact while the workload writes unique
+                # values)
+                have: Counter = Counter(logs[thread])
+                taken: Counter = Counter()
+                for v, r in zip(canonical, crevs):
+                    if taken[v] < have[v]:
+                        taken[v] += 1
+                    else:
+                        missing_pairs.append((v, r))
+            missing = [v for v, _ in missing_pairs]
             unattributed = [
-                v for v in missing
-                if not any(lo < value_rev.get(v, -1) <= hi
-                           for lo, hi in gaps[thread])]
+                v for v, r in missing_pairs
+                if r is None or not any(lo < r <= hi
+                                        for lo, hi in gaps[thread])]
             if not is_subsequence(logs[thread], canonical) or unattributed:
                 deltas.append({"thread": thread,
                                "edit-distance": len(unattributed) or 1,

@@ -299,6 +299,22 @@ class HttpEtcdClient(Client):
                             return
                         msg = json.loads(line.decode("utf-8"))
                         res = msg.get("result", {})
+                        if res.get("canceled"):
+                            # compaction cancel: carry the server's
+                            # compact_revision so the workload restarts
+                            # at the true horizon instead of falling
+                            # back to max-observed-revision (which can
+                            # overstate the unobservable gap and
+                            # silently weaken the watch verdict)
+                            err = SimError(
+                                "compacted",
+                                res.get("cancel_reason", "canceled"))
+                            cr = res.get("compact_revision")
+                            if cr is not None:
+                                err.compact_revision = int(cr)
+                            if not stop["flag"]:
+                                loop.call_soon_threadsafe(on_error, err)
+                            return
                         evs = []
                         for e in res.get("events", []):
                             kv = _kv_from_wire(e["kv"]) if "kv" in e \

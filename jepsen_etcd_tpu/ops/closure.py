@@ -33,8 +33,13 @@ if HAVE_JAX:
     import jax
     import jax.numpy as jnp
 
-#: below this node count, numpy squaring beats a device round-trip
-CPU_CUTOFF = 256
+#: below this node count, numpy squaring beats a device round-trip.
+#: MEASURED (r4, 6 subgraphs of N nodes, iterative squaring, v5e
+#: through axon): N=256 host 0.020 s vs device 0.149 s; N=512 host
+#: 0.189 s vs 0.328 s; N=1024 host 1.53 s vs 0.68 s; N=2048 host
+#: 13.2 s vs 1.95 s; N=4096 host 102 s vs 6.1 s. Crossover ~768 —
+#: the device pays a ~0.1 s tunnel round trip, the host pays O(N^3).
+CPU_CUTOFF = 768
 #: at/above this node count (with >1 device), shard rows over the mesh
 SHARD_CUTOFF = 1024
 

@@ -71,8 +71,15 @@ def rotate_store(base: str, keep_dir: str = None,
         total -= size
         removed.append(rd)
     if removed:
-        logger.info("store rotation: removed %d old runs (%s over cap)",
-                    len(removed), base)
+        # WARNING with the list: rotation is on by default (2 GiB cap)
+        # and may remove runs of OTHER tests under the store base —
+        # pre-existing artifacts a user cares about deserve a loud,
+        # attributable line (JEPSEN_ETCD_TPU_STORE_MAX_BYTES=0 opts out)
+        logger.warning(
+            "store rotation: removed %d old run dirs under %s "
+            "(cap %d bytes; set JEPSEN_ETCD_TPU_STORE_MAX_BYTES=0 to "
+            "disable): %s", len(removed), base, max_bytes,
+            ", ".join(removed))
         for link in [os.path.join(base, "latest")] + [
                 os.path.join(base, t, "latest")
                 for t in os.listdir(base)

@@ -24,6 +24,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
+from .errors import SimError
 from .store import Store, Txn
 
 
@@ -298,8 +299,26 @@ class _Handler(BaseHTTPRequestHandler):
                         events = [e for e in
                                   st.store.events_since(last + 1)
                                   if e.key == key and e.revision > last]
-                    except Exception:
-                        return  # compacted past the watch: close stream
+                    except SimError as e:
+                        # compacted past the watch: real etcd cancels
+                        # the stream with a WatchResponse carrying
+                        # compact_revision so the client can restart
+                        # past the horizon (api_reference: watch
+                        # cancel semantics); mirror that framing.
+                        # Only the store's compaction error — anything
+                        # else is a real bug and must propagate, not
+                        # masquerade as a compact cancel
+                        if e.type != "compacted":
+                            raise
+                        chunk({"result": {
+                            "canceled": True,
+                            "cancel_reason":
+                                "etcdserver: mvcc: required revision "
+                                "has been compacted",
+                            "compact_revision": str(
+                                getattr(e, "compact_revision", None)
+                                or st.store.compact_revision)}})
+                        return
                     rev = st.store.revision
                 if events:
                     last = max(e.revision for e in events)
