@@ -227,6 +227,7 @@ def bench_engine_crossover():
     ops = list(h)
     mid = len(ops) // 2
     adv = [Op(dict(o)) for o in ops]
+    injected = False
     for i in range(mid, len(adv)):
         o = adv[i]
         if o.get("type") == "ok" and o.get("f") == "read" \
@@ -234,20 +235,26 @@ def bench_engine_crossover():
             v = list(o["value"])
             v[1] = 424242
             adv[i]["value"] = v
+            injected = True
             break
     ha = History(adv)
     pa = wgl.pack_register_history(ha)
-    t1 = time.time()
-    nat = check_history(VersionedRegister(), ha, max_configs=5_000_000)
-    nat_s = time.time() - t1
-    t1 = time.time()
-    mxu = wgl_mxu.check_packed_mxu(pa)
-    mxu_s = time.time() - t1
-    note(f"crossover adversarial: native={nat_s:.3f}s ({nat['valid?']}) "
-         f"mxu={mxu_s:.3f}s ({mxu['valid?']})")
-    adv_row = {"entries": len(ha), "native_s": round(nat_s, 4),
-               "mxu_s": round(mxu_s, 4), "both_false":
-               nat["valid?"] is False and mxu["valid?"] is False}
+    if injected and pa.ok and wgl_mxu.supported(pa):
+        t1 = time.time()
+        nat = check_history(VersionedRegister(), ha,
+                            max_configs=5_000_000)
+        nat_s = time.time() - t1
+        t1 = time.time()
+        mxu = wgl_mxu.check_packed_mxu(pa)
+        mxu_s = time.time() - t1
+        note(f"crossover adversarial: native={nat_s:.3f}s "
+             f"({nat['valid?']}) mxu={mxu_s:.3f}s ({mxu['valid?']})")
+        adv_row = {"entries": len(ha), "native_s": round(nat_s, 4),
+                   "mxu_s": round(mxu_s, 4), "both_false":
+                   nat["valid?"] is False and mxu["valid?"] is False}
+    else:
+        adv_row = {"skipped": ("no injectable read" if not injected
+                               else "pack unsupported")}
     # value = the largest measured speedup row (kernel vs native)
     if rows:
         full = max(rows, key=lambda r: r["entries"])

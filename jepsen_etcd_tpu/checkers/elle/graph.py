@@ -34,7 +34,7 @@ import numpy as np
 logger = logging.getLogger("jepsen_etcd_tpu.checkers")
 
 from ...core.history import History
-from ...ops.closure import closure_batch_lazy
+from ...ops.closure import closure_levels_lazy
 
 WW, WR, RW, RT = "ww", "wr", "rw", "realtime"
 
@@ -140,6 +140,12 @@ class DepGraph:
         ops that never completed carry +inf and get no outgoing edges)."""
         self.rt = complete_idx[:, None] < invoke_idx[None, :]
         np.fill_diagonal(self.rt, False)
+        # kept for the compact device path: the dense rt matrix is
+        # derivable from these two N-vectors on device, so the closure
+        # launch ships ~KBs instead of the O(B*N^2) bool stack (80 MB
+        # at the append bench's 3.7k txns — ~2 s of tunnel bandwidth)
+        self._rt_vecs = (np.asarray(invoke_idx, dtype=np.float64),
+                         np.asarray(complete_idx, dtype=np.float64))
 
     # -- analysis ------------------------------------------------------------
 
@@ -196,14 +202,29 @@ class DepGraph:
         if self.n == 0:
             return []
         levels = [(WW,), (WW, WR), (WW, WR, RW)]
-        if realtime and self.rt is not None:
+        use_rt = realtime and self.rt is not None
+        if use_rt:
             levels += [(WW, RT), (WW, WR, RT), (WW, WR, RW, RT)]
-        stack = np.stack([self._dense(*ets) for ets in levels])
-        # reach is fetched lazily: only certificate recovery on invalid
+        # compact inputs: per-type edge lists + the rt vectors; the
+        # device path builds the level stack on-chip (shipping the
+        # dense bool stack cost ~2 s of tunnel bandwidth at 3.7k txns),
+        # while host/sharded paths densify lazily as before. reach is
+        # fetched lazily: only certificate recovery on invalid
         # histories touches it, so valid checks skip the O(B*N^2)
         # device->host transfer
-        reach_fn, on_cycle = closure_batch_lazy(stack,
-                                                force_device=force_device)
+        et_order = (WW, WR, RW)
+        lvl_mask = np.array(
+            [[et in ets for et in et_order] + [RT in ets]
+             for ets in levels])
+        et_edges = [np.array(sorted(self.edges[et]),
+                             np.int32).reshape(-1, 2)
+                    for et in et_order]
+        rt_vecs = getattr(self, "_rt_vecs", None) if use_rt else None
+        reach_fn, on_cycle = closure_levels_lazy(
+            et_edges, lvl_mask, self.n, rt_vecs,
+            densify=lambda: np.stack([self._dense(*ets)
+                                      for ets in levels]),
+            force_device=force_device)
         adjs: dict[int, dict] = {}
 
         def adj(li: int) -> dict:

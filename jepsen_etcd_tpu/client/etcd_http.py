@@ -300,18 +300,29 @@ class HttpEtcdClient(Client):
                         msg = json.loads(line.decode("utf-8"))
                         res = msg.get("result", {})
                         if res.get("canceled"):
-                            # compaction cancel: carry the server's
-                            # compact_revision so the workload restarts
-                            # at the true horizon instead of falling
-                            # back to max-observed-revision (which can
-                            # overstate the unobservable gap and
-                            # silently weaken the watch verdict)
-                            err = SimError(
-                                "compacted",
-                                res.get("cancel_reason", "canceled"))
+                            # servers also cancel watches for
+                            # NON-compaction reasons (failed create,
+                            # shutdown); classifying those as
+                            # "compacted" would let the checker excuse
+                            # real missing events as a phantom gap —
+                            # gate on the compaction evidence
+                            reason = res.get("cancel_reason", "canceled")
                             cr = res.get("compact_revision")
-                            if cr is not None:
-                                err.compact_revision = int(cr)
+                            if cr is not None and int(cr) > 0 \
+                                    or "compacted" in reason.lower():
+                                # compaction cancel: carry the true
+                                # horizon so the workload restarts
+                                # there instead of at max-observed
+                                # revision (which can overstate the
+                                # unobservable gap)
+                                err = SimError("compacted", reason)
+                                if cr is not None:
+                                    err.compact_revision = int(cr)
+                            else:
+                                err = SimError("unavailable",
+                                               f"watch canceled: "
+                                               f"{reason}",
+                                               definite=False)
                             if not stop["flag"]:
                                 loop.call_soon_threadsafe(on_error, err)
                             return

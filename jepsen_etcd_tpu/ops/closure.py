@@ -116,6 +116,40 @@ if HAVE_JAX:
         return run(jax.device_put(pad, sh))
 
 
+if HAVE_JAX:
+
+    @partial(jax.jit, static_argnames=("b", "m", "iters", "n_types"))
+    def _closure_from_edges(edges, lvl_mask, inv_v, comp_v, b, m,
+                            iters, n_types):
+        """Compact-input closure: build the [B, m, m] level stack ON
+        DEVICE from typed edge lists plus the realtime vectors, then
+        square. Ships O(E + N) bytes instead of O(B*N^2) — the dense
+        bool stack was ~80 MB at the append bench's 3.7k txns, ~2 s of
+        tunnel bandwidth (PERF.md).
+
+        edges: [E, 3] int32 (type, i, j), padded rows filled with
+        POSITIVE out-of-range indices (type = n_types, i = j = m) —
+        negative indices would WRAP before mode="drop"'s bounds check
+        and set a real spurious edge;
+        lvl_mask: [B, n_types+1] bool (last column = include realtime);
+        inv_v / comp_v: [m] f32 invoke/complete indices (+inf pad).
+        """
+        et = jnp.zeros((n_types, m, m), dtype=bool)
+        et = et.at[edges[:, 0], edges[:, 1], edges[:, 2]].set(
+            True, mode="drop")
+        rt = comp_v[:, None] < inv_v[None, :]
+        rt = rt & ~jnp.eye(m, dtype=bool)
+        planes = []
+        for bi in range(b):
+            x = jnp.zeros((m, m), dtype=bool)
+            for t in range(n_types):
+                x = x | (et[t] & lvl_mask[bi, t])
+            x = x | (rt & lvl_mask[bi, n_types])
+            planes.append(x)
+        a = jnp.stack(planes)
+        return _closure_device(a, iters)
+
+
 def _closure_numpy(a: np.ndarray) -> tuple:
     n = a.shape[-1]
     r = a | np.eye(n, dtype=bool)[None]
@@ -126,6 +160,61 @@ def _closure_numpy(a: np.ndarray) -> tuple:
         r = np.matmul(r.astype(np.int32), r.astype(np.int32)) > 0
     on_cycle = np.any(a & np.swapaxes(r, -1, -2), axis=-1)
     return r, on_cycle
+
+
+def closure_levels_lazy(et_edges: list, lvl_mask: np.ndarray, n: int,
+                        rt_vecs, densify,
+                        force_device: bool | None = None):
+    """closure_batch_lazy with COMPACT device inputs: per-type edge
+    lists + the realtime (invoke, complete) vectors; the [B, N, N]
+    level stack is built on device (_closure_from_edges). densify() is
+    only called on the host / multi-device-sharded paths, which keep
+    the dense pipeline. Same return contract as closure_batch_lazy."""
+    b, n_types = lvl_mask.shape[0], lvl_mask.shape[1] - 1
+    n_dev = len(jax.devices()) if HAVE_JAX else 1
+    m = _bucket(max(1, n))
+    if m % max(1, n_dev):
+        m = ((m + n_dev - 1) // n_dev) * n_dev
+    if (n == 0
+            or not use_device(force_device, n, CPU_CUTOFF,
+                              "closure_batch")
+            or (n_dev > 1 and m >= SHARD_CUTOFF)):
+        # host / sharded / empty: the dense pipeline handles these —
+        # one copy of that routing lives in closure_batch_lazy
+        return closure_batch_lazy(densify() if n else
+                                  np.zeros((b, 0, 0), bool),
+                                  force_device=force_device)
+    iters = max(1, math.ceil(math.log2(m)))
+    rows = [np.column_stack([np.full(len(e), t, np.int32),
+                             np.asarray(e, np.int32).reshape(-1, 2)])
+            for t, e in enumerate(et_edges) if len(e)]
+    edges = (np.concatenate(rows) if rows
+             else np.zeros((0, 3), np.int32))
+    e_pad = _bucket(max(1, len(edges)))
+    # padding rows use positive OUT-OF-RANGE indices: negative ones
+    # wrap before mode="drop"'s bounds check and plant a real edge
+    epad = np.empty((e_pad, 3), dtype=np.int32)
+    epad[:, 0] = n_types
+    epad[:, 1] = epad[:, 2] = m
+    epad[:len(edges)] = edges
+    inv_v = np.full(m, np.inf, dtype=np.float32)
+    comp_v = np.full(m, np.inf, dtype=np.float32)
+    if rt_vecs is not None:
+        inv_v[:n] = rt_vecs[0]
+        comp_v[:n] = rt_vecs[1]
+    reach_dev, on_cycle = _closure_from_edges(
+        jnp.asarray(epad), jnp.asarray(lvl_mask),
+        jnp.asarray(inv_v), jnp.asarray(comp_v),
+        b, m, iters, n_types)
+    on_cycle = np.asarray(on_cycle)[:, :n]
+    cache: list = []
+
+    def reach_fn():
+        if not cache:
+            cache.append(np.asarray(reach_dev)[:, :n, :n])
+        return cache[0]
+
+    return reach_fn, on_cycle
 
 
 def closure_batch_lazy(adj: np.ndarray, force_device: bool | None = None):

@@ -18,7 +18,7 @@ from jepsen_etcd_tpu.ops import wgl
 
 
 def gen_history(rng: random.Random, n_procs=4, n_ops=20, values=3,
-                corrupt=False, info_rate=0.0):
+                corrupt=False, info_rate=0.0, dur_scale=1.0):
     """Random concurrent register history via linearization-point
     simulation: ops apply atomically at a random instant inside their
     [invoke, complete] span, so the generated history is linearizable by
@@ -35,7 +35,7 @@ def gen_history(rng: random.Random, n_procs=4, n_ops=20, values=3,
     for p in range(n_procs):
         at = rng.random()
         for _ in range(n_ops // n_procs):
-            dur = 0.1 + rng.random()
+            dur = (0.1 + rng.random()) * dur_scale
             spans.append((at, at + dur, p))
             at += dur + rng.random() * 0.3
     is_info = [rng.random() < info_rate for _ in spans]

@@ -91,6 +91,34 @@ def test_device_table_builder_matches_host_packer():
     assert checked >= 10, f"only {checked}/20 comparable"
 
 
+def test_w64_differential():
+    """High-concurrency histories widen the window to two mask words;
+    the w=64 kernel variant must agree with the jnp engine (and the
+    CPU oracle through it) on both verdict polarities."""
+    rng = random.Random(6464)
+    checked = 0
+    for trial in range(30):
+        # many processes with LONG op spans -> deep overlap -> the
+        # undecided window exceeds one mask word
+        h = gen_history(rng, n_procs=rng.randint(12, 20),
+                        n_ops=rng.randint(60, 120),
+                        corrupt=(trial % 2 == 1), dur_scale=20.0)
+        p = wgl.pack_register_history(h)
+        if not p.ok or p.w != 64 or not wgl_mxu.supported(p):
+            continue
+        got = wgl_mxu.check_packed_mxu(p)
+        if got["valid?"] == "unknown":
+            continue
+        ref = wgl.check_packed(p)
+        if ref["valid?"] == "unknown":
+            continue
+        checked += 1
+        assert got["valid?"] == ref["valid?"], (
+            f"trial {trial}: mxu={got} ref={ref['valid?']}\n"
+            + h.to_jsonl())
+    assert checked >= 5, f"only {checked}/30 w=64 comparable"
+
+
 def test_batch_matches_singles():
     rng = random.Random(31)
     hs = [gen_history(rng, n_procs=3, n_ops=rng.randint(8, 40),
