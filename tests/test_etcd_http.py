@@ -326,3 +326,37 @@ def test_wall_loop_waits_for_in_flight_pool_work():
     loop.run()  # no timers: an early idle exit would drop the callback
     assert got == [42]
     loop.shutdown()
+
+
+def test_watch_compaction_cancel_carries_compact_revision(gateway):
+    """A watch below the compact horizon must come back as a compacted
+    cancel CARRYING the server's compact_revision (real etcd's canceled
+    WatchResponse framing) — the final-watch restart uses it to resume
+    at the true horizon instead of guessing from max-observed revision
+    (r3 advisor finding)."""
+    endpoint, _ = gateway
+
+    async def main():
+        c = HttpEtcdClient(endpoint)
+        from jepsen_etcd_tpu.runner.sim import current_loop, sleep
+        loop = current_loop()
+        for i in range(6):
+            await c.put("ck", i)
+        await c.compact(5)
+        done = loop.future()
+
+        def on_events(evs):
+            pass
+
+        def on_error(e):
+            if not done.done:
+                done.set_result(e)
+
+        w = c.watch("ck", 1, on_events, on_error)  # below the horizon
+        err = await done
+        w.cancel()
+        assert isinstance(err, SimError) and err.type == "compacted", err
+        assert getattr(err, "compact_revision", None) == 5, vars(err)
+        return True
+
+    assert run(main())
