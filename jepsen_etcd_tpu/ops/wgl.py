@@ -94,13 +94,16 @@ F_MAX_BIG = 4096  # top of the in-kernel retry ladder; past this the
 # per-wave cost is dominated by the dedup sort of F*(w+classes)
 # candidates, so running above the needed capacity wastes time
 # proportionally. The ladder ascends geometrically and the search
-# settles at the smallest rung that fits its peak frontier (profiled
-# on the deep 4n/2000 register bench: peak 954, median wave 92 —
-# a 128->512->4096 ladder parked 97% of waves at 4096, 4x the cost
-# of the 1024 rung the search actually needed; healthy single-key
-# searches peak in the tens, so the ladder bottoms at 32 — the 10k-op
-# headline bench runs 1.8x faster there than at 128).
-LADDER = [32, 128, F_MAX, 1024, 2048, F_MAX_BIG]
+# settles at the smallest rung that fits its peak frontier; the
+# frontier-resume makes an extra rung nearly free for histories that
+# overflow past it. Profiled on the r4 deep 4n/2000 register bench
+# (the r2 profile's peak-954 history no longer exists — the r4
+# simulator rework changed generated histories): peak 252, so rung 512
+# pays double the needed per-wave sort (measured 1.59 s vs 1.00 s at
+# the 256 rung). Healthy single-key searches peak in the tens, so the
+# ladder bottoms at 32 — the 10k-op headline bench runs 1.8x faster
+# there than at 128.
+LADDER = [32, 128, 256, F_MAX, 1024, 2048, F_MAX_BIG]
 SENTINEL_D = np.int32(2 ** 31 - 1)
 SENTINEL_W = np.uint32(0xFFFFFFFF)
 SENTINEL_V = np.int32(2 ** 31 - 1)
@@ -1191,10 +1194,13 @@ def check_packed(p: Packed, f_max: Optional[int] = None,
         ladder = [f_max] + [f for f in LADDER if f > f_max]
     if p.w == W_MAX:
         # W=128 kernels compile slowly and their overflows are almost
-        # always combinatorial blowup: cap the in-kernel ladder and let
-        # the DFS-first overflow path (TPULinearizableChecker._overflow)
+        # always combinatorial blowup: cap the in-kernel ladder (and
+        # skip the 256 rung — one fewer multi-minute w=128 compile on a
+        # path that nearly always ends at the DFS anyway) and let the
+        # DFS-first overflow path (TPULinearizableChecker._overflow)
         # take it from there
-        ladder = [f for f in ladder if f <= F_MAX] or [ladder[0]]
+        ladder = [f for f in ladder
+                  if f <= F_MAX and f != 256] or [ladder[0]]
     _c_pad, ni, _i_tab = info_dims(p)
     tables = {k: jnp.asarray(v)
               for k, v in pad_tables(p, bucket(p.R)).items()}
