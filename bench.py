@@ -109,9 +109,13 @@ def bench_register_10k():
     p = wgl.pack_register_history(h)
     assert p.ok, p.reason
     wgl.check_packed(p)  # warmup: compile + first search
-    t1 = time.time()
-    out = wgl.check_packed(p)
-    dt = time.time() - t1
+    # best of 3: a synchronized tunnel round trip carries tens of ms
+    # of jitter (PERF.md), which is material at this cell's scale
+    dt = 1e9
+    for _ in range(3):
+        t1 = time.time()
+        out = wgl.check_packed(p)
+        dt = min(dt, time.time() - t1)
     # cost split: host per-op packing; device-resident exec (tables
     # already shipped) isolates tunnel transfer+latency from compute
     r_pad = max(wgl.bucket(p.R), wgl_mxu.TSUB)
