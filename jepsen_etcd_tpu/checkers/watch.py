@@ -143,9 +143,32 @@ class WatchChecker(Checker):
             return out
 
         crevs = canonical_occurrence_revs()
+        ccount = Counter(canonical)
+        dup_values = any(c > 1 for c in ccount.values())
+
+        def greedy_missing(thread, reverse=False):
+            have: Counter = Counter(logs[thread])
+            taken: Counter = Counter()
+            pairs = list(zip(canonical, crevs))
+            if reverse:
+                pairs = pairs[::-1]
+            out = []
+            for v, r in pairs:
+                if taken[v] < have[v]:
+                    taken[v] += 1
+                else:
+                    out.append((v, r))
+            return out[::-1] if reverse else out
+
+        def unattributed_of(thread, pairs):
+            return [v for v, r in pairs
+                    if r is None or not any(lo < r <= hi
+                                            for lo, hi in gaps[thread])]
+
         for thread in gapped:
             trevs = revs.get(thread, [])
             missing_pairs = []
+            indefinite = False
             if len(trevs) == len(logs[thread]):
                 # match by the thread's OWN recorded (value, revision)
                 # pairs: a thread that saw only the LATER of two writes
@@ -161,36 +184,56 @@ class WatchChecker(Checker):
             else:
                 # no per-event revisions recorded: greedy value-count
                 # matching (exact while the workload writes unique
-                # values)
-                have: Counter = Counter(logs[thread])
-                taken: Counter = Counter()
-                for v, r in zip(canonical, crevs):
-                    if taken[v] < have[v]:
-                        taken[v] += 1
-                    else:
-                        missing_pairs.append((v, r))
-            missing = [v for v, _ in missing_pairs]
-            unattributed = [
-                v for v, r in missing_pairs
-                if r is None or not any(lo < r <= hi
-                                        for lo, hi in gaps[thread])]
+                # values). With duplicate values the start-anchored
+                # assignment can hand a sighting to the wrong
+                # occurrence, so also try the end-anchored one; if
+                # neither attributes every miss to a gap, the evidence
+                # is ambiguous — downgrade to indefinite rather than
+                # report a possibly-false violation
+                missing_pairs = greedy_missing(thread)
+                if dup_values and unattributed_of(thread, missing_pairs):
+                    alt = greedy_missing(thread, reverse=True)
+                    if len(unattributed_of(thread, alt)) < \
+                            len(unattributed_of(thread, missing_pairs)):
+                        missing_pairs = alt
+                    rest = unattributed_of(thread, missing_pairs)
+                    # only assignment ambiguity is indefinite: a missed
+                    # value is reassignable only when canonical repeats
+                    # it AND the thread sighted it at least once —
+                    # otherwise every occurrence is missing under every
+                    # assignment and the miss is determined, so it
+                    # stays a definite violation
+                    have: Counter = Counter(logs[thread])
+                    if rest and all(ccount[v] > 1 and have[v] > 0
+                                    for v in rest):
+                        indefinite = True
+            unattributed = unattributed_of(thread, missing_pairs)
             if not is_subsequence(logs[thread], canonical) or unattributed:
-                deltas.append({"thread": thread,
-                               "edit-distance": len(unattributed) or 1,
-                               "gaps": gaps[thread],
-                               "unattributed-missing": unattributed[:32],
-                               "diff": diff_report(canonical,
-                                                   logs[thread])})
+                delta = {"thread": thread,
+                         "edit-distance": len(unattributed) or 1,
+                         "gaps": gaps[thread],
+                         "unattributed-missing": unattributed[:32],
+                         "diff": diff_report(canonical,
+                                             logs[thread])}
+                # out-of-order sightings stay definite violations even
+                # under duplicate values; only pure attribution
+                # ambiguity is indefinite
+                if indefinite and is_subsequence(logs[thread], canonical):
+                    delta["indefinite"] = True
+                deltas.append(delta)
         deltas.sort(key=lambda d: -d["edit-distance"])
         nm_errors = [op["error"] for op in h
                      if isinstance(op.get("error"), (list, tuple))
                      and op["error"] and op["error"][0] == "nonmonotonic-watch"]
+        definite_deltas = [d for d in deltas if not d.get("indefinite")]
         if nm_errors:
             valid = False
         elif len(set(revisions.values())) > 1:
             valid = "unknown"
-        elif deltas:
+        elif definite_deltas:
             valid = False
+        elif deltas:
+            valid = "unknown"
         else:
             valid = True
         out = {"valid?": valid, "revisions": revisions}

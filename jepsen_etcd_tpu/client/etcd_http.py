@@ -307,17 +307,23 @@ class HttpEtcdClient(Client):
                             # real missing events as a phantom gap —
                             # gate on the compaction evidence
                             reason = res.get("cancel_reason", "canceled")
-                            cr = res.get("compact_revision")
-                            if cr is not None and int(cr) > 0 \
-                                    or "compacted" in reason.lower():
+                            try:
+                                cr = int(res.get("compact_revision"))
+                            except (TypeError, ValueError):
+                                # a non-numeric compact_revision (a
+                                # gateway str() fallback can yield
+                                # "None") must not escape as a generic
+                                # error and lose the compaction framing
+                                cr = 0
+                            if cr > 0 or "compacted" in reason.lower():
                                 # compaction cancel: carry the true
                                 # horizon so the workload restarts
                                 # there instead of at max-observed
                                 # revision (which can overstate the
                                 # unobservable gap)
                                 err = SimError("compacted", reason)
-                                if cr is not None:
-                                    err.compact_revision = int(cr)
+                                if cr > 0:
+                                    err.compact_revision = cr
                             else:
                                 err = SimError("unavailable",
                                                f"watch canceled: "

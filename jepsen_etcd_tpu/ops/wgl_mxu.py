@@ -95,9 +95,13 @@ def _dims(wk: int):
 def supported(p: Packed) -> bool:
     """Preconditions: packed OK, one- or two-word window, no info ops,
     value ids and history length within the uint16 shipping budget
-    (others fall back to the jnp ladder)."""
+    (others fall back to the jnp ladder). The shift bound guards the
+    uint16 C_SHIFT column of the host/device bit-identity contract:
+    shift <= w for every packing today, but a future packing that
+    widened it must fall back rather than silently truncate."""
     return (bool(p.ok) and p.w in W_SUPPORTED and p.I == 0 and p.R > 0
-            and p.n_values < VAL_MAX and p.R < 65000)
+            and p.n_values < VAL_MAX and p.R < 65000
+            and int(np.max(p.shift, initial=0)) < 65536)
 
 
 def pack_tables(p: Packed, r_pad: int):

@@ -577,10 +577,15 @@ class Cluster:
         self._expire_leases(leader)
 
     async def _send_append(self, leader: Node, peer_name: str) -> None:
-        await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
-        # past the coalescing window: appends after this point need (and
-        # will get) a fresh sender
-        leader.send_inflight.discard(peer_name)
+        try:
+            await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+        finally:
+            # past the coalescing window: appends after this point need
+            # (and will get) a fresh sender. Cleared in finally — a
+            # cancel thrown at the sleep suspension point must not leak
+            # the coalescing flag, or _replicate_now would never spawn
+            # another sender for this peer
+            leader.send_inflight.discard(peer_name)
         peer = self.nodes.get(peer_name)
         if (peer is None or leader.role != "leader" or not leader.alive
                 or not self.reachable(leader.name, peer_name)

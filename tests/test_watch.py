@@ -324,6 +324,34 @@ def test_watch_checker_gapped_log_never_defines_canonical():
     assert r["valid?"] is True, r
 
 
+def test_watch_checker_dup_value_no_revs_end_anchored_rescue():
+    """Duplicate canonical value, gapped thread with NO recorded revs
+    that saw only the LATER occurrence: start-anchored greedy matching
+    would misassign the sighting to the earlier occurrence and flag the
+    later revision (outside the gap) missing — a false violation. The
+    end-anchored pass attributes every miss to the gap."""
+    h = H(watch_inv(0), full_ok(0, [10, 11, 10, 13], [2, 3, 4, 5], 5),
+          watch_inv(1), full_ok(1, [10, 11, 10, 13], [2, 3, 4, 5], 5),
+          # thread 2 saw the rev-4 occurrence of 10; gap covers revs 2-3
+          watch_inv(2), gapped_ok(2, [10, 13], [], 5, [[1, 3]]))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] is True, r
+
+
+def test_watch_checker_dup_value_no_revs_ambiguous_is_unknown():
+    """Duplicate canonical value, no recorded revs, and NEITHER
+    anchoring attributes every miss: the evidence is ambiguous, so the
+    verdict downgrades to unknown instead of a definite violation."""
+    h = H(watch_inv(0), full_ok(0, [10, 11, 10], [2, 3, 4], 4),
+          watch_inv(1), full_ok(1, [10, 11, 10], [2, 3, 4], 4),
+          # gap covers only rev 3; whichever occurrence of 10 the
+          # sighting is assigned to, the other one's miss is outside
+          watch_inv(2), gapped_ok(2, [10], [], 4, [[2, 3]]))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] == "unknown", r
+    assert any(d.get("indefinite") for d in r["deltas"])
+
+
 def test_watch_admin_compaction_gap_e2e(tmp_path):
     """Aggressive admin (compact/defrag) cadence that compacts under the
     final watch: the watcher must restart past the compact horizon,
@@ -382,3 +410,29 @@ def test_watch_member_failover_e2e(tmp_path):
     wl = out["results"]["workload"]
     assert wl["valid?"] is True, wl
     assert out["valid?"] is True
+
+
+def test_watch_checker_dup_value_unique_miss_stays_definite():
+    """A duplicate value elsewhere in canonical must not excuse a
+    definite miss of a UNIQUE value: no re-anchoring can ever move it
+    into a gap, so the violation stays False, not unknown."""
+    h = H(watch_inv(0), full_ok(0, [10, 11, 10, 20], [2, 3, 4, 5], 5),
+          watch_inv(1), full_ok(1, [10, 11, 10, 20], [2, 3, 4, 5], 5),
+          # thread 2 saw everything except unique value 20 (rev 5);
+          # its gap covers nothing near rev 5
+          watch_inv(2), gapped_ok(2, [10, 11, 10], [], 5, [[0, 1]]))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] is False, r
+
+
+def test_watch_checker_dup_value_no_sighting_stays_definite():
+    """Every occurrence of a duplicated value missing (the thread never
+    sighted it at all): no assignment ambiguity exists, so an
+    out-of-gap miss stays a definite violation."""
+    h = H(watch_inv(0), full_ok(0, [10, 11, 10], [2, 3, 4], 4),
+          watch_inv(1), full_ok(1, [10, 11, 10], [2, 3, 4], 4),
+          # thread 2 saw only 11; rev-4 occurrence of 10 is outside the
+          # gap under EVERY assignment
+          watch_inv(2), gapped_ok(2, [11], [], 4, [[1, 2]]))
+    r = WatchChecker().check({"concurrency": 4}, h)
+    assert r["valid?"] is False, r
