@@ -137,6 +137,9 @@ class History:
         return f"<History of {len(self.ops)} ops>"
 
 
+_SCALAR_TYPES = frozenset((str, int, float, bool, type(None)))
+
+
 def _jsonable(x: Any) -> Any:
     """JSON encoding that round-trips tuples and sets (tagged).
 
@@ -154,6 +157,13 @@ def _jsonable(x: Any) -> Any:
     if isinstance(x, tuple):
         return {"__tuple__": [_jsonable(v) for v in x]}
     if isinstance(x, list):
+        # fast path: a list of plain scalars is already JSON-clean and
+        # json.dumps serializes it at C speed — recursing per element
+        # made big read values (e.g. the set workload's full-set reads)
+        # dominate history serialization. set(map(type, x)) runs the
+        # whole scan in C
+        if not set(map(type, x)) - _SCALAR_TYPES:
+            return x
         return [_jsonable(v) for v in x]
     if isinstance(x, (set, frozenset)):
         return {"__set__": sorted((_jsonable(v) for v in x), key=repr)}

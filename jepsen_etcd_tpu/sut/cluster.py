@@ -127,14 +127,12 @@ class Node:
         self.match_index: dict[str, int] = {}
         self.lease_expiry: dict[int, int] = {}
         self.waiters: dict[int, tuple[int, Future]] = {}  # index->(term,fut)
-        # durability (bytes on "disk"); both WAL views are bytearrays so
-        # per-entry appends are amortized O(1) in every fsync config —
-        # rebuilding a bytes object per append made long runs quadratic
-        # in WAL size
-        self._wal_buf = bytearray()
-        self._wal_durable_buf = bytearray()
-        self.snap_current = b""
-        self.snap_durable = b""
+        # durability ("files" on disk). RecordFiles hold records as
+        # objects and only materialize framed CRC bytes when a
+        # corruption fault touches them — value-carrying records made
+        # per-append pickling O(history²) on append-heavy workloads
+        self.wal = walmod.RecordFile()
+        self.snap = walmod.RecordFile()
         self.applied_since_snap = 0
         # observability
         self.etcd_log: list[str] = []
@@ -179,41 +177,21 @@ class Node:
 
     # ---- durability -------------------------------------------------------
 
-    @property
-    def wal_current(self) -> bytes:
-        """The WAL "file" contents (snapshot copy of the live buffer)."""
-        return bytes(self._wal_buf)
-
-    @wal_current.setter
-    def wal_current(self, b: bytes) -> None:
-        self._wal_buf = bytearray(b)
-        if not self.cluster.cfg.unsafe_no_fsync:
-            # fsync mode: rewrites (conflict truncation, recovery
-            # re-encode) are fsynced like etcd's, keeping the durable
-            # buffer an exact mirror so per-append fast syncs stay valid
-            self._wal_durable_buf = bytearray(b)
-
-    @property
-    def wal_durable(self) -> bytes:
-        return bytes(self._wal_durable_buf)
-
-    @wal_durable.setter
-    def wal_durable(self, b: bytes) -> None:
-        self._wal_durable_buf = bytearray(b)
-
-    @property
-    def wal_size(self) -> int:
-        return len(self._wal_buf)
-
     def wal_append(self, e: LogEntry) -> None:
-        rec = walmod.record_bytes((e.index, e.term, e.kind, e.payload))
-        self._wal_buf += rec
-        if not self.cluster.cfg.unsafe_no_fsync:
-            self._wal_durable_buf += rec  # fsync-per-append, still O(1)
+        # fsync-per-append unless --unsafe-no-fsync, as etcd does
+        self.wal.append((e.index, e.term, e.kind, e.payload),
+                        sync=not self.cluster.cfg.unsafe_no_fsync)
+
+    def wal_rewrite(self, entries: list) -> None:
+        """Wholesale WAL rewrite (conflict truncation, recovery
+        re-encode): fsynced like etcd's unless --unsafe-no-fsync."""
+        self.wal.set_records(
+            [(e.index, e.term, e.kind, e.payload) for e in entries],
+            sync=not self.cluster.cfg.unsafe_no_fsync)
 
     def fsync(self) -> None:
-        self.wal_durable = self.wal_current
-        self.snap_durable = self.snap_current
+        self.wal.fsync()
+        self.snap.fsync()
 
     def maybe_snapshot(self) -> None:
         if self.applied_since_snap < self.cluster.cfg.snapshot_count:
@@ -224,13 +202,12 @@ class Node:
         self.snap_term = ent.term if ent else self.term
         snap = (applied, self.snap_term, self.store.clone(),
                 list(self.membership), dict(self.leases))
-        self.snap_current = walmod.encode_records([snap])
+        self.snap.set_records([snap], sync=True)
         # drop the log prefix; rebuild the WAL from the snapshot point
         keep = self.log[max(0, applied + 1 - self.log_start):]
         self.log = keep
         self.log_start = applied + 1
-        self.wal_current = walmod.encode_records(
-            [(e.index, e.term, e.kind, e.payload) for e in keep])
+        self.wal_rewrite(keep)
         self.fsync()  # etcd fsyncs snapshots even with --unsafe-no-fsync
         self.applied_since_snap = 0
         self.log_line(f"saved snapshot at index {applied}")
@@ -651,8 +628,7 @@ class Cluster:
                     if w is not None:
                         w[1].set_exception(SimError("leader-changed",
                                                     "entry overwritten"))
-                peer.wal_current = walmod.encode_records(
-                    [(e.index, e.term, e.kind, e.payload) for e in peer.log])
+                peer.wal_rewrite(peer.log)
             for e in entries:
                 if peer.entry(e.index) is None:
                     peer.log.append(LogEntry(e.index, e.term, e.kind,
@@ -674,7 +650,7 @@ class Cluster:
     def _install_snapshot(self, leader: Node, peer: Node) -> None:
         self._trace("snapshot", leader.name, peer.name,
                     index=leader.snap_index, delivered=True)
-        snap_items, err = walmod.decode_records(leader.snap_current)
+        snap_items, err = leader.snap.read()
         if err or not snap_items:
             # leader snapshot bytes damaged: send live state (etcd would
             # alarm; we keep the cluster moving and log it)
@@ -697,12 +673,12 @@ class Cluster:
             peer.log = []
             peer.log_start = idx + 1
             peer.commit_index = idx
-        # re-encode from the received state — snapshot transfer is
+        # re-save from the received state — snapshot transfer is
         # CRC-verified in etcd, so damaged leader bytes must not propagate
-        peer.snap_current = walmod.encode_records([
+        peer.snap.set_records([
             (peer.snap_index, peer.snap_term, peer.store.clone(),
-             list(peer.membership), dict(peer.leases))])
-        peer.wal_current = b""
+             list(peer.membership), dict(peer.leases))], sync=True)
+        peer.wal.clear()
         peer.fsync()
         peer.applied_since_snap = 0
         peer.log_line(f"installed snapshot at index {peer.snap_index}")
@@ -976,7 +952,7 @@ class Cluster:
             "raft-term": n.term,
             "raft-index": n.last_index(),
             "revision": n.store.revision,
-            "db-size": n.wal_size + len(n.snap_current),
+            "db-size": n.wal.size + n.snap.size,
             "member-count": len(n.membership),
             "is-leader": n.role == "leader",
         }
@@ -1037,8 +1013,8 @@ class Cluster:
         for w in list(n.watchers):
             w.cancel(SimError("unavailable", "node killed"))
         if lose_unfsynced or (self.cfg.lazyfs and self.cfg.unsafe_no_fsync):
-            n.wal_current = n.wal_durable
-            n.snap_current = n.snap_durable
+            n.wal.lose_unfsynced()
+            n.snap.lose_unfsynced()
         if n.resume_event is not None:
             n.resume_event.set()
             n.resume_event = None
@@ -1058,8 +1034,8 @@ class Cluster:
         if n.alive:
             return
         if fresh:
-            n.wal_current = n.wal_durable = b""
-            n.snap_current = n.snap_durable = b""
+            n.wal.clear()
+            n.snap.clear()
             n.log = []
             n.log_start = 1
             n.snap_index = n.snap_term = 0
@@ -1091,7 +1067,7 @@ class Cluster:
         # while a silently-damaged snapshot diverges and gets caught
         n.fp_ledger = {}
         # snapshot
-        snap_items, snap_err = walmod.decode_records(n.snap_current)
+        snap_items, snap_err = n.snap.read()
         if snap_err == "crc-mismatch":
             n.log_line("panic: snap: crc mismatch, cannot load snapshot")
             raise SimError("corrupt", f"{n.name} snapshot corrupt")
@@ -1111,15 +1087,14 @@ class Cluster:
             n.membership = list(self.initial_names)
             n.leases = {}
         # wal
-        items, err = walmod.decode_records(n.wal_current)
+        items, err = n.wal.read()
         if err == "crc-mismatch":
             n.log_line("panic: walpb: crc mismatch")
             raise SimError("corrupt", f"{n.name} WAL corrupt")
         # torn-record at the tail is tolerated (mid-write crash)
         n.log = [LogEntry(i, t, k, p) for (i, t, k, p) in items
                  if i >= n.log_start]
-        n.wal_current = walmod.encode_records(
-            [(e.index, e.term, e.kind, e.payload) for e in n.log])
+        n.wal_rewrite(n.log)
         # HardState: etcd persists (term, vote) in its WAL and fsyncs it
         # before answering RPCs, so a restarted voter can never re-grant
         # its vote in the same term (raft election safety). We model the
@@ -1172,17 +1147,13 @@ class Cluster:
     def corrupt_file(self, name: str, which: str = "wal",
                      mode: str = "bitflip", probability: float = 1e-4,
                      truncate_bytes: int = 1024) -> None:
-        """Damage durable bytes (nemesis.clj:159-198)."""
+        """Damage durable bytes (nemesis.clj:159-198). Materializes the
+        file's framed CRC bytes (BYTES mode) so the damage lands on the
+        same byte layout real etcd replay would see."""
         n = self.nodes[name]
-        buf = n.wal_current if which == "wal" else n.snap_current
-        if mode == "bitflip":
-            buf = walmod.bitflip(buf, self.loop.rng, probability)
-        else:
-            buf = walmod.truncate(buf, self.loop.rng, truncate_bytes)
-        if which == "wal":
-            n.wal_current = n.wal_durable = buf
-        else:
-            n.snap_current = n.snap_durable = buf
+        f = n.wal if which == "wal" else n.snap
+        f.corrupt(self.loop.rng, mode=mode, probability=probability,
+                  truncate_bytes=truncate_bytes)
         n.log_line(f"file corrupted: {which} ({mode})")
 
     def wipe_node(self, name: str) -> None:
@@ -1191,8 +1162,8 @@ class Cluster:
         rm -rf so wiped files can't come back when unsynced writes are
         later dropped)."""
         n = self.nodes[name]
-        n.wal_current = n.wal_durable = b""
-        n.snap_current = n.snap_durable = b""
+        n.wal.clear()
+        n.snap.clear()
 
     def checkpoint_node(self, name: str) -> None:
         """lazyfs checkpoint! analog (db.clj:35-36): flush current file

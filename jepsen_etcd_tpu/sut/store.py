@@ -109,6 +109,10 @@ class Store:
         self.compact_revision = 0
         self.kvs: dict[str, KeyState] = {}
         self.events: list[tuple[int, list[Event]]] = []  # (rev, events)
+        # clones share the events list copy-on-write (snapshots clone
+        # every snapshot_count entries; eagerly copying the whole event
+        # history each time is O(history) per snapshot)
+        self._events_shared = False
         # lease id -> set of keys currently attached (rebuilt with state)
         self.lease_keys: dict[int, set] = {}
 
@@ -221,6 +225,12 @@ class Store:
         if mutates:
             self.revision = new_rev
             if events:
+                if self._events_shared:
+                    # break COW sharing before the in-place append;
+                    # entries are immutable once committed, so a
+                    # shallow copy suffices
+                    self.events = list(self.events)
+                    self._events_shared = False
                 self.events.append((new_rev, events))
         return {"succeeded": succeeded, "results": results,
                 "revision": self.revision, "events": events,
@@ -234,8 +244,11 @@ class Store:
                            f"compact revision {rev} > current {self.revision}",
                            definite=True)
         self.compact_revision = max(self.compact_revision, rev)
+        # rebuilds (rather than mutates) the list, so sharing clones
+        # keep their view; this store's copy is now unshared
         self.events = [(r, evs) for r, evs in self.events
                        if r > self.compact_revision]
+        self._events_shared = False
 
     def events_since(self, rev: int) -> list[Event]:
         """Events with revision >= rev (for watch catch-up).
@@ -271,6 +284,23 @@ class Store:
                          f"{ks.create_revision}:{ks.mod_revision}:{ks.lease}")
         return zlib.crc32("\n".join(parts).encode())
 
+    def est_size(self) -> int:
+        """Rough byte-size estimate for the db-size stat (picked up by
+        wal._est_size when this store sits inside an OBJ-mode snapshot
+        record): tracks kv payload and retained-event volume without
+        pickling the state."""
+        from .wal import _est_size
+        import itertools
+        sz = 64
+        n = len(self.kvs)
+        if n:
+            sample = itertools.islice(self.kvs.items(), 64)
+            per = sum(32 + len(k) + _est_size(ks.value)
+                      for k, ks in sample) / min(n, 64)
+            sz += int(per * n)
+        sz += 24 * len(self.events)
+        return sz
+
     def clone(self) -> "Store":
         new = Store.__new__(Store)
         new.revision = self.revision
@@ -280,6 +310,11 @@ class Store:
         new.kvs = {k: KeyState(v.value, v.version,
                                v.create_revision, v.mod_revision, v.lease)
                    for k, v in self.kvs.items()}
-        new.events = [(r, list(evs)) for r, evs in self.events]
+        # events share copy-on-write: (rev, events) entries are
+        # immutable once committed, and the first in-place append on
+        # either side breaks the sharing
+        new.events = self.events
+        new._events_shared = True
+        self._events_shared = True
         new.lease_keys = {l: set(ks) for l, ks in self.lease_keys.items()}
         return new

@@ -512,3 +512,104 @@ def test_fsync_mode_survives_lose_unfsynced():
         cluster.shutdown()
     finally:
         set_current_loop(None)
+
+
+# ---- RecordFile (lazy byte materialization, wal.py) -----------------------
+
+def test_record_file_obj_mode_roundtrip():
+    """OBJ mode: appends/fsync/lose_unfsynced never touch bytes."""
+    from jepsen_etcd_tpu.sut.wal import RecordFile
+    f = RecordFile()
+    f.append(("a", 1), sync=True)
+    f.append(("b", [1, 2, 3]), sync=True)
+    f.append(("c", 3), sync=False)          # unfsynced tail
+    assert not f.byte_mode
+    items, err = f.read()
+    assert err is None and [i[0] for i in items] == ["a", "b", "c"]
+    f.lose_unfsynced()
+    items, err = f.read()
+    assert err is None and [i[0] for i in items] == ["a", "b"]
+    assert f.size > 0
+
+
+def test_record_file_corruption_materializes_and_breaks_crc():
+    """Corruption flips to BYTES mode; a bitflipped record fails CRC at
+    replay exactly as the framed encoding dictates."""
+    import random
+    from jepsen_etcd_tpu.sut.wal import RecordFile
+    f = RecordFile()
+    for i in range(8):
+        f.append((i, i * 10), sync=True)
+    f.corrupt(random.Random(5), mode="bitflip", probability=0.01)
+    assert f.byte_mode
+    items, err = f.read()
+    # a flip in a payload breaks that record's CRC; a flip in a length
+    # field can instead make the tail read torn — damaged either way
+    assert err in ("crc-mismatch", "torn-record")
+    assert len(items) < 8
+    # wholesale rewrite (recovery re-encode) returns to OBJ mode
+    f.set_records(items, sync=True)
+    assert not f.byte_mode
+    assert f.read() == (items, None)
+
+
+def test_record_file_truncate_drops_tail_records():
+    import random
+    from jepsen_etcd_tpu.sut.wal import RecordFile
+    f = RecordFile()
+    for i in range(6):
+        f.append((i, "x" * 50), sync=True)
+    f.corrupt(random.Random(3), mode="truncate", truncate_bytes=80)
+    items, err = f.read()
+    assert err == "torn-record"     # mid-write tail is tolerated
+    assert 0 < len(items) < 6
+
+
+def test_record_file_bytes_mode_append_and_lose():
+    """After corruption the byte buffer is authoritative: appends frame
+    onto it and lose_unfsynced rolls back to the durable bytes."""
+    import random
+    from jepsen_etcd_tpu.sut.wal import RecordFile
+    f = RecordFile()
+    f.append((1, "a"), sync=True)
+    f.corrupt(random.Random(7), mode="bitflip", probability=0.0)  # no-op flip
+    assert f.byte_mode
+    f.append((2, "b"), sync=False)
+    items, err = f.read()
+    assert err is None and len(items) == 2
+    f.lose_unfsynced()
+    items, err = f.read()
+    assert err is None and len(items) == 1
+
+
+def test_store_clone_events_cow():
+    """Clones share the events list; an append on either side breaks the
+    sharing without disturbing the other's view."""
+    from jepsen_etcd_tpu.sut.store import Store
+    s = Store()
+    s.apply_txn(Txn((), (("put", "k", 1, 0),), ()))
+    snap = s.clone()
+    assert snap.events is s.events
+    s.apply_txn(Txn((), (("put", "k", 2, 0),), ()))
+    assert snap.events is not s.events
+    assert len(snap.events) == 1 and len(s.events) == 2
+
+
+def test_record_file_unsynced_rewrite_preserves_damaged_durable():
+    """Corrupt, then an UNSYNCED wholesale rewrite (recovery re-encode
+    under --unsafe-no-fsync): the durable view must keep the damaged
+    bytes so a later lose-unfsynced crash + replay still sees the
+    damage — the rewrite must not launder it into a clean prefix."""
+    import random
+    from jepsen_etcd_tpu.sut.wal import RecordFile
+    f = RecordFile()
+    for i in range(8):
+        f.append((i, "v" * 40), sync=True)
+    f.corrupt(random.Random(2), mode="bitflip", probability=0.02)
+    _, err0 = f.read()
+    assert err0 is not None
+    f.set_records([(0, "clean")], sync=False)   # unsynced rewrite
+    assert f.read() == ([(0, "clean")], None)   # current view is clean
+    f.lose_unfsynced()                          # crash: back to disk
+    _, err1 = f.read()
+    assert err1 == err0                         # damage survived
