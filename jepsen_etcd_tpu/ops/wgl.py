@@ -54,9 +54,8 @@ import numpy as np
 
 logger = logging.getLogger("jepsen_etcd_tpu.ops")
 
-#: set after the fused Pallas kernel fails once: a broken toolchain
+#: set after the fused MXU kernel fails once: a broken toolchain
 #: disables the fast path for the rest of the process
-_pallas_broken = [False]
 _mxu_broken = [False]
 
 
@@ -1169,21 +1168,20 @@ def check_packed(p: Packed, f_max: Optional[int] = None,
         # overflow again.
         # Engine order on real TPU: the MXU wave kernel (ops/wgl_mxu.py
         # — one table stream, matmul compaction, ~6x the r3 fused
-        # kernel end-to-end at 50k scale), then the r3 pick-loop kernel
-        # for shapes the MXU one doesn't take, then the complete jnp
-        # ladder. A Mosaic failure in either kernel degrades to the
-        # next engine and disables that kernel for the process.
-        # Real-chip only: in interpret mode (CPU CI) the fused kernels
-        # are python-slow, and their correctness is pinned directly by
-        # tests/test_wgl_mxu.py and tests/test_wgl_pallas.py
+        # kernel end-to-end at 50k scale), then the complete jnp
+        # ladder. A Mosaic failure in the kernel degrades to the
+        # ladder and disables the kernel for the process. (The r3
+        # pick-loop kernel was retired in r5: its supported shapes were
+        # a strict subset of the MXU kernel's, and both are Mosaic
+        # kernels, so it could only ever run in the vanishing window
+        # where one Mosaic compile fails and the other succeeds — the
+        # jnp ladder is the real backstop either way.)
+        # Real-chip only: in interpret mode (CPU CI) the fused kernel
+        # is python-slow, and its correctness is pinned directly by
+        # tests/test_wgl_mxu.py
         from . import wgl_mxu
         out = _run_fused(_mxu_broken, "mxu wave",
                          lambda: wgl_mxu.check_packed_mxu(p))
-        if out is not None and not out.get("overflow"):
-            return out
-        from . import wgl_pallas
-        out = _run_fused(_pallas_broken, "fused wave",
-                         lambda: wgl_pallas.check_packed_pallas(p))
         if out is not None and not out.get("overflow"):
             return out
     # f_max (when given) is the STARTING rung; the ladder still

@@ -1,7 +1,8 @@
 """MXU-compacted Pallas wave kernel for the WGL frontier BFS.
 
-Second-generation fused kernel (supersedes ops/wgl_pallas.py on its
-shape class: W <= 64 window, no info ops). The r3 kernel's cost was
+Second-generation fused kernel (supersedes the retired r3 pick-loop
+kernel on its shape class: W <= 64 window, no info ops; the r3 kernel
+lives in git history at tag r4 as ops/wgl_pallas.py). Its cost was
 measured to be dominated by vector->scalar round trips in its greedy
 dedupe pick loop (~1.2 us per pick on a v5e through axon) plus one
 DMA-visible stream per table; and every device engine pays the axon
