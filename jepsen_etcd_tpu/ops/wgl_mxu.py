@@ -72,6 +72,10 @@ VAL_MAX = 2 ** 16 - 3       # value-id budget (uint16 biased +1)
 # int32 SMEM scal columns
 S_SHIFT, S_CEILB, S_UPD0, S_UPD1, S_R = range(5)
 SCAL_COLS = 8
+#: largest r_pad whose (r_pad*wk, r_pad) one-hot gather matrix fits
+#: comfortably (<= ~34 MB bf16 at w=64, x the build's 16-key vmap
+#: chunk ~0.5 GB transient); deeper histories keep the serial gather
+OH_MAX_RPAD = 512
 
 U16_NOASSERT = 65535
 U16_INF = 65534
@@ -192,7 +196,20 @@ def pack_perop(p: Packed, r_pad: int):
 def _build_tables_one(jnp, lax, i32, u16, r_pad: int, wk: int):
     """Device-side frame builder for ONE key: -> (r_pad, TLANES) int32
     tab, (r_pad, SCAL_COLS) int32 scal. Bit-identical to pack_tables
-    (differentially tested)."""
+    (differentially tested).
+
+    All eight per-op columns are gathered at the SAME sliding-window
+    index (lo_k + o), so instead of eight `jnp.take` gathers — which
+    lower to the TPU's serial gather unit and dominated the r4 build
+    (~0.2 s at 512 keys) — ONE one-hot matrix rides the MXU: each
+    one-hot row selects exactly one source element, so the contraction
+    has a single nonzero term and is exact whenever the operand is,
+    and 8-bit limb decomposition keeps every operand bf16-exact.
+
+    The one-hot matrix is O(r_pad^2 * wk) bytes, so it only pays (and
+    only fits) on the short-history shapes the batched key-DP axis
+    produces; past OH_MAX_RPAD deep single keys keep the serial-gather
+    path, whose cost is amortized over one big search."""
     nw, nr, np_, segk, pl, tlanes = _dims(wk)
     u = u16.astype(jnp.int32)
     invr = i32[:, 0]
@@ -205,8 +222,39 @@ def _build_tables_one(jnp, lax, i32, u16, r_pad: int, wk: int):
     in_range = (pos < R) & (kr < R)
     idx = jnp.clip(pos, 0, jnp.maximum(R - 1, 0))
 
-    def g(col):
-        return jnp.take(u[:, col], idx, axis=0)      # (r_pad, wk)
+    if r_pad <= OH_MAX_RPAD:
+        # one-hot gather: limb columns (values 0..255, bf16-exact) for
+        # the six u16 cols (2 limbs) and the two time-rank cols
+        # (3 limbs: ranks < 65000 * 2 < 2^18)
+        gather_cols = (C_VER, C_A1, C_A2, C_FSK1, C_PRED, C_CEIL)
+        limbs = []
+        for c in gather_cols:
+            limbs += [u[:, c] & 0xFF, (u[:, c] >> 8) & 0xFF]
+        for arr in (invr, retr):
+            limbs += [arr & 0xFF, (arr >> 8) & 0xFF, (arr >> 16) & 0xFF]
+        V = jnp.stack(limbs, axis=1).astype(jnp.bfloat16)  # (r_pad, 18)
+        flat = idx.reshape(r_pad * wk, 1)
+        rr = lax.broadcasted_iota(jnp.int32, (r_pad * wk, r_pad), 1)
+        OH = (flat == rr).astype(jnp.bfloat16)
+        G = lax.dot_general(OH, V, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        G = G.astype(jnp.int32).reshape(r_pad, wk, len(limbs))
+
+        def g(col):
+            ci = 2 * gather_cols.index(col)
+            return G[:, :, ci] | (G[:, :, ci + 1] << 8)   # (r_pad, wk)
+
+        base = 2 * len(gather_cols)
+        invg = G[:, :, base] | (G[:, :, base + 1] << 8) \
+            | (G[:, :, base + 2] << 16)                  # (r_pad, wk)
+        retg = G[:, :, base + 3] | (G[:, :, base + 4] << 8) \
+            | (G[:, :, base + 5] << 16)
+    else:
+        def g(col):
+            return jnp.take(u[:, col], idx, axis=0)      # (r_pad, wk)
+
+        invg = jnp.take(invr, idx, axis=0)
+        retg = jnp.take(retr, idx, axis=0)
 
     fsk = jnp.where(in_range & (g(C_PRED) <= kr), g(C_FSK1), 0)
     a1p = g(C_A1)
@@ -221,8 +269,6 @@ def _build_tables_one(jnp, lax, i32, u16, r_pad: int, wk: int):
     ceilabs = g(C_CEIL)
     relceil = jnp.where((ceilabs == U16_INF) | ~in_range, 32767,
                         jnp.clip((ceilabs - 1) - uf, -1, wk + 1))
-    retg = jnp.take(retr, idx, axis=0)               # (r_pad, wk)
-    invg = jnp.take(invr, idx, axis=0)
     bits = ((retg[:, None, :] < invg[:, :, None])
             & in_range[:, None, :])                  # (r_pad, wk, wk)
     wts32 = (jnp.uint32(1) << (jnp.arange(wk, dtype=jnp.uint32) % 32))
@@ -266,8 +312,17 @@ def _build_tables_one(jnp, lax, i32, u16, r_pad: int, wk: int):
 
 def _wave_body(jnp, lax, pl_mod, pltpu, wk, row_t, shift, ceilb, upd0,
                upd1, kk, R, stw_p, stv_p, alive_p, xs, rs, acc_p,
-               ovf_p, peak_p, wav_p):
-    """One BFS wave on the packed planes. No vector->scalar syncs."""
+               ovf_p, peak_p, wav_p, mseg_p, plane_p):
+    """One BFS wave on the packed planes. No vector->scalar syncs.
+
+    Reductions that the r4 body ran as pltpu.roll butterflies (per-state
+    min-ceiling, global candidate ranks) ride the MXU here as matmuls
+    against constant 0/1 matrices hoisted into VMEM scratch (mseg_p,
+    plane_p, built once at kk==0): every operand is a small integer
+    (indicators, counts <= NP), exactly representable in bf16 with f32
+    accumulation, so the matmul reduction is bit-exact while replacing
+    ~25 (min-ceil) and ~40 (ranks) serial vector ops with one MXU pass
+    each — measured ~2x on the per-wave cost at w=64."""
     nw, nr, np_, segk, pl, tlanes = _dims(wk)
     lane = lax.broadcasted_iota(jnp.int32, (nr, 128), 1)
     o = lane % wk                        # window op index per slot
@@ -312,19 +367,17 @@ def _wave_body(jnp, lax, pl_mod, pltpu, wk, row_t, shift, ceilb, upd0,
         preds_in = preds_in & ((sw[1] & pmask[1]) == pmask[1])
         version = version + lax.population_count(
             sw[1] & jnp.uint32(upd1)).astype(jnp.int32)
-    # per-STATE min ceiling among its not-yet-linearized window ops:
-    # a state's wk candidate lanes live in one wk-lane segment, so this
-    # is a segment-local all-reduce — butterfly of wrapped rolls (the
-    # wrap re-enters the same segment, so no cross-state mixing)
-    mc = jnp.where(not_set, rceil, 2 ** 30)
-    d = 1
-    while d < wk:
-        wrapped = jnp.where(lane % wk >= d, pltpu.roll(mc, d, 1),
-                            pltpu.roll(mc, d - wk + 128, 1))
-        mc = jnp.minimum(mc, wrapped)
-        d *= 2
-    min_ceil = jnp.minimum(mc, ceilb)
-    alive = alive & (version <= min_ceil)
+    # per-STATE ceiling prune: a state dies when any not-yet-linearized
+    # window op has rceil < version (equivalently version > the segment
+    # min ceiling). version is constant across a state's wk-lane
+    # segment, so the min-reduce collapses to a segment-OR of a
+    # violation indicator — ONE matmul against the block-diagonal
+    # segment-membership matrix (0/1 bf16, f32 accumulate: exact)
+    bad = (not_set & (rceil < version)).astype(jnp.bfloat16)
+    segbad = lax.dot_general(bad, mseg_p[...],
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    alive = alive & (version <= ceilb) & (segbad < 0.5)
 
     is_read = fsk == (1 + READ)
     is_write = fsk == (1 + WRITE)
@@ -401,21 +454,27 @@ def _wave_body(jnp, lax, pl_mod, pltpu, wk, row_t, shift, ceilb, upd0,
         dup = dup | same_mask(pltpu.roll(stk, dd, 1), lane >= dd)
     valid = valid & ~dup
 
-    # dense ranks via log-shift prefix sums (vector only)
+    # dense ranks: exclusive global prefix sum in row-major slot order,
+    # as TWO matmul reductions (bf16 0/1 operands, f32 accumulate —
+    # exact for counts <= NP): lanes-before via the strict-lower
+    # triangular matrix, rows-above via a tiny (nr, nr) triangle
     vi = valid.astype(jnp.int32)
-    acc = vi
-    d = 1
-    while d < 128:
-        acc = acc + jnp.where(lane >= d, pltpu.roll(acc, d, 1), 0)
-        d *= 2
-    rowtot = acc[:, 127:128]
-    srow1 = lax.broadcasted_iota(jnp.int32, (nr, 1), 0)
-    racc = rowtot
-    d = 1
-    while d < nr:
-        racc = racc + jnp.where(srow1 >= d, pltpu.roll(racc, d, 0), 0)
-        d *= 2
-    rank = acc - vi + (racc - rowtot)    # exclusive global rank
+    vb = valid.astype(jnp.bfloat16)
+    lanes_before = lax.dot_general(vb, plane_p[...],
+                                   (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+    rowtot_b = lax.dot_general(
+        vb, jnp.ones((128, 128), jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)       # (nr, 128) row totals
+    rio_t = lax.broadcasted_iota(jnp.int32, (nr, nr), 0)
+    cio_t = lax.broadcasted_iota(jnp.int32, (nr, nr), 1)
+    tri_r = (rio_t > cio_t).astype(jnp.bfloat16)  # strict lower (nr, nr)
+    rows_above = lax.dot_general(
+        tri_r, rowtot_b.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)       # (nr, 128)
+    rank = (lanes_before + rows_above).astype(jnp.int32)
 
     # flags BEFORE compaction: acceptance is witness-based; overflow =
     # any candidate ranked past capacity
@@ -517,7 +576,7 @@ def _make_kernel(batched: bool, wk: int):
     nw, nr, np_, segk, pl_n, tlanes = _dims(wk)
 
     def kernel(tab_ref, scal_ref, out_ref, stw_p, stv_p, alive_p, xs,
-               rs, acc_p, ovf_p, peak_p, wav_p, sm):
+               rs, acc_p, ovf_p, peak_p, wav_p, mseg_p, plane_p, sm):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -541,6 +600,13 @@ def _make_kernel(batched: bool, wk: int):
             ovf_p[...] = jnp.zeros((nr, 128), jnp.int32)
             peak_p[...] = init
             wav_p[...] = jnp.zeros((nr, 128), jnp.int32)
+            # constant reduction matrices for the wave body's MXU
+            # reductions (built once, reused every wave): segment
+            # membership and strict-lower lane triangle
+            l1 = lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+            l2 = lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+            mseg_p[...] = ((l1 // wk) == (l2 // wk)).astype(jnp.bfloat16)
+            plane_p[...] = (l1 < l2).astype(jnp.bfloat16)
             sm[0] = 0
 
         row_t = tab_ref[pl.ds(sub, 1), :]
@@ -554,7 +620,7 @@ def _make_kernel(batched: bool, wk: int):
         def _wave():
             _wave_body(jnp, lax, pl, pltpu, wk, row_t, shift, ceilb,
                        upd0, upd1, kk, R, stw_p, stv_p, alive_p, xs,
-                       rs, acc_p, ovf_p, peak_p, wav_p)
+                       rs, acc_p, ovf_p, peak_p, wav_p, mseg_p, plane_p)
 
         # frontier-death check: one vector->scalar sync every
         # DONE_EVERY waves lets dead/padding steps skip the body
@@ -599,6 +665,8 @@ def _scratch_shapes(wk: int):
         pltpu.VMEM((nr, 128), jnp.int32),        # ovf_p
         pltpu.VMEM((nr, 128), jnp.int32),        # peak_p
         pltpu.VMEM((nr, 128), jnp.int32),        # wav_p
+        pltpu.VMEM((128, 128), jnp.bfloat16),    # mseg_p (const)
+        pltpu.VMEM((128, 128), jnp.bfloat16),    # plane_p (const)
         pltpu.SMEM((8,), jnp.int32),
     ]
 
@@ -630,7 +698,7 @@ def _call_single(r_pad: int, wk: int, interpret: bool):
     def run(i32, u16):
         from jax import lax
         tab, scal = _build_tables_one(jnp, lax, i32, u16, r_pad, wk)
-        return call(tab, scal)
+        return _summarize(jnp, call(tab, scal))
 
     return jax.jit(run)
 
@@ -662,9 +730,10 @@ def _call_batch(k_keys: int, r_pad: int, wk: int, interpret: bool):
     )
 
     # inputs are compact per-op arrays shipped 2D (the tunnel moves 3D
-    # arrays pathologically slowly); frames build on device — one
-    # lax.map step per key bounds the (r_pad, wk, wk) pred-bit
-    # intermediates to ~1-4 MB each
+    # arrays pathologically slowly); frames build on device — chunked
+    # vmap (batch_size) bounds the (chunk, r_pad, wk, wk) pred-bit
+    # intermediates to ~30 MB while cutting the per-key sequential
+    # scan that dominated the r4 build time (~0.1 s at 512 keys)
     def run(i32_2d, u16_2d):
         from jax import lax
         i32r = i32_2d.reshape(k_keys, r_pad, 4)
@@ -674,17 +743,30 @@ def _call_batch(k_keys: int, r_pad: int, wk: int, interpret: bool):
             return _build_tables_one(jnp, lax, args[0], args[1],
                                      r_pad, wk)
 
-        tabs, scals = lax.map(one, (i32r, u16r))
-        return call(tabs, scals)
+        tabs, scals = lax.map(one, (i32r, u16r),
+                              batch_size=min(16, k_keys))
+        return _summarize(jnp, call(tabs, scals))
 
     return jax.jit(run)
 
 
+def _summarize(jnp, out):
+    """Fold the per-key (32, 128) flag block into 4 per-key scalars
+    [accepted, overflowed, peak, waves] ON DEVICE. The raw block is
+    8.4 MB at 512 keys — ~0.2 s of readback through the tunnel's
+    30-50 MB/s — where the summary is 8 KB."""
+    acc = out[..., 0:8, :].max(axis=(-2, -1))
+    ovf = out[..., 8:16, :].max(axis=(-2, -1))
+    peak = out[..., 16:24, :].max(axis=(-2, -1))
+    wav = out[..., 24:32, :].max(axis=(-2, -1))
+    return jnp.stack([acc, ovf, peak, wav], axis=-1)
+
+
 def _decode(out: np.ndarray, p: Packed) -> dict:
-    acc = out[0:8].any()
-    ovf = out[8:16].any()
-    peak = int(out[16:24].max())
-    waves = int(out[24:32].max())
+    acc = bool(out[0])
+    ovf = bool(out[1])
+    peak = int(out[2])
+    waves = int(out[3])
     if acc:
         res = {"valid?": True, "waves": waves, "peak-frontier": peak,
                "ops": p.R, "info-ops": 0, "engine": "mxu-wave"}
@@ -733,6 +815,10 @@ def check_packed_batch_mxu(packs: list) -> list | None:
     for i, p in enumerate(packs):
         if supported(p):
             groups.setdefault((max(bucket(p.R), TSUB), p.w), []).append(i)
+    # launch every (bucket, width) group BEFORE reading any back: the
+    # dispatches pipeline on device, so the batch pays one tunnel
+    # round trip total instead of one per group
+    launched = []
     for (r_pad, wk), idxs in groups.items():
         # bucket the key count so the jit cache holds O(log K) variants
         # instead of one compile per distinct batch size; padding keys
@@ -747,9 +833,12 @@ def check_packed_batch_mxu(packs: list) -> list | None:
             a, b = pack_perop(packs[i], r_pad)
             i32s[j] = a
             u16s[j] = b
-        out = np.asarray(_call_batch(k_pad, r_pad, wk, interpret)(
+        dev = _call_batch(k_pad, r_pad, wk, interpret)(
             jnp.asarray(i32s.reshape(k_pad * r_pad, 4)),
-            jnp.asarray(u16s.reshape(k_pad * r_pad, 12))))
+            jnp.asarray(u16s.reshape(k_pad * r_pad, 12)))
+        launched.append((idxs, dev))
+    for idxs, dev in launched:
+        out = np.asarray(dev)
         for j, i in enumerate(idxs):
             results[i] = _decode(out[j], packs[i])
     return results

@@ -87,6 +87,21 @@ def test_device_table_builder_matches_host_packer():
                         for x in build(jnp.asarray(i32), jnp.asarray(u16))]
         assert (t_dev == t_host).all(), f"trial {trial}: table mismatch"
         assert (s_dev == s_host).all(), f"trial {trial}: scal mismatch"
+        if checked == 0:
+            # deep-history branch: past OH_MAX_RPAD the builder swaps
+            # the one-hot matmul gather for serial jnp.take — both
+            # must stay bit-identical to the host packer
+            rp_big = 2 * wgl_mxu.OH_MAX_RPAD
+            t_h2, s_h2 = wgl_mxu.pack_tables(p, rp_big)
+            i2, u2 = wgl_mxu.pack_perop(p, rp_big)
+            build2 = jax.jit(lambda a, b, wk=p.w:
+                             wgl_mxu._build_tables_one(jnp, lax, a, b,
+                                                       rp_big, wk))
+            t_d2, s_d2 = [np.asarray(x)
+                          for x in build2(jnp.asarray(i2),
+                                          jnp.asarray(u2))]
+            assert (t_d2 == t_h2).all(), "deep-branch table mismatch"
+            assert (s_d2 == s_h2).all(), "deep-branch scal mismatch"
         checked += 1
     assert checked >= 10, f"only {checked}/20 comparable"
 
