@@ -367,7 +367,13 @@ def bench_register_50k():
 def bench_batched_512_keys():
     """Scale cell: 512 independent keys (concurrency 16 -> w=64
     windows for most keys, exercising the two-word kernel). kernel_s =
-    one MXU dispatch per (bucket, width) group."""
+    one MXU dispatch per (bucket, width) group — r5 cut it ~4x (one-hot
+    matmul table gather, matmul wave reductions, 8 KB readback), under
+    the 0.45 s r4-production bar. The router still keeps the native
+    sweep in production here BY MEASUREMENT: r5 also sped the shared
+    host path up (~1.4x), and at 200-entry keys the per-key Python
+    packing floor alone exceeds the native DFS's entire per-key budget
+    (BATCH_DFS_MAX's measured table in checkers/tpu_linearizable.py)."""
     from jepsen_etcd_tpu.ops import wgl, wgl_mxu
     from jepsen_etcd_tpu.checkers.tpu_linearizable import (
         TPULinearizableChecker)

@@ -129,14 +129,22 @@ SPILL_STATE_BUDGET_HIGH_I = 1_000_000
 
 def pack_bits(bits: np.ndarray, nw: int) -> np.ndarray:
     """Pack a trailing bool axis of width w = 32*nw into nw
-    little-endian uint32 words (new trailing axis replaces it)."""
+    little-endian uint32 words (new trailing axis replaces it).
+    np.packbits + a little-endian uint32 view is ~10x the widen-
+    multiply-reduce formulation on the packer's (R, W, W) tensors."""
     w = bits.shape[-1]
     assert w <= 32 * nw
-    padded = np.zeros(bits.shape[:-1] + (32 * nw,), dtype=np.uint32)
-    padded[..., :w] = bits
-    b32 = (np.uint32(1) << np.arange(32, dtype=np.uint32))
-    return (padded.reshape(bits.shape[:-1] + (nw, 32)) * b32).sum(
-        -1, dtype=np.uint32)
+    if w < 32 * nw:
+        padded = np.zeros(bits.shape[:-1] + (32 * nw,), dtype=bool)
+        padded[..., :w] = bits
+        bits = padded
+    by = np.packbits(np.ascontiguousarray(bits), axis=-1,
+                     bitorder="little")
+    return by.view(np.uint32).reshape(bits.shape[:-1] + (nw,)) \
+        if by.dtype.byteorder in "|=" and np.little_endian \
+        else (by.astype(np.uint32).reshape(bits.shape[:-1] + (nw, 4))
+              * (np.uint32(1) << (8 * np.arange(4, dtype=np.uint32)))
+              ).sum(-1, dtype=np.uint32)
 
 
 @dataclass
@@ -262,33 +270,41 @@ def _pack_register_history(history, adapter) -> Packed:
 
     inv = np.array([e.invoke for e in req], dtype=np.int64)
     ret = np.array([e.ret for e in req], dtype=np.int64)
-    f = np.zeros(R, dtype=np.int8)
-    a1 = np.zeros(R, dtype=np.int32)
-    a2 = np.zeros(R, dtype=np.int32)
-    ver = np.full(R, NO_ASSERT, dtype=np.int32)
+    # build as Python lists (one numpy scalar-assignment per op costs
+    # more than the whole list); convert once below
+    f_l = [0] * R
+    a1_l = [0] * R
+    a2_l = [0] * R
+    ver_l = [NO_ASSERT] * R
     for i, e in enumerate(req):
         ef, ev = fv(e)
         if ef == "read":
-            f[i] = READ
             rv, rval = ev if ev is not None else (None, None)
-            ver[i] = NO_ASSERT if rv is None else as_version(rv)
+            if rv is not None:
+                ver_l[i] = as_version(rv)
             # A None read value asserts nothing (VersionedRegister.step
             # treats nil op-value as unchecked REGARDLESS of version —
             # an unset-key read [0, None] is constrained via version 0).
-            a1[i] = WILDCARD if rval is None else val_id(rval)
+            a1_l[i] = WILDCARD if rval is None else val_id(rval)
         elif ef == "write":
-            f[i] = WRITE
+            f_l[i] = WRITE
             wv, wval = ev
-            ver[i] = NO_ASSERT if wv is None else as_version(wv)
-            a1[i] = val_id(wval)
+            if wv is not None:
+                ver_l[i] = as_version(wv)
+            a1_l[i] = val_id(wval)
         elif ef == "cas":
-            f[i] = CAS
+            f_l[i] = CAS
             cv, (old, new) = ev
-            ver[i] = NO_ASSERT if cv is None else as_version(cv)
-            a1[i] = val_id(old)
-            a2[i] = val_id(new)
+            if cv is not None:
+                ver_l[i] = as_version(cv)
+            a1_l[i] = val_id(old)
+            a2_l[i] = val_id(new)
         else:
             return Packed(ok=False, reason=f"op f={ef!r} not supported")
+    f = np.array(f_l, dtype=np.int8)
+    a1 = np.array(a1_l, dtype=np.int32)
+    a2 = np.array(a2_l, dtype=np.int32)
+    ver = np.array(ver_l, dtype=np.int32)
 
     # --- info (indefinite) ops: may linearize any time after their
     # required predecessors, or never. Reads are droppable (invoke value
@@ -338,8 +354,8 @@ def _pack_register_history(history, adapter) -> Packed:
     # never fire. Crashed writes of distinct never-observed values
     # collapse from 2^I subsets to one symmetry class.
     from .common import register_value_sets
-    triples = [(int(f[i]), int(a1[i]), int(a2[i])) for i in range(R)] + \
-              [(int(i_f[j]), int(i_a1[j]), int(i_a2[j])) for j in range(I)]
+    triples = list(zip(f.tolist(), a1.tolist(), a2.tolist())) + \
+        list(zip(i_f.tolist(), i_a1.tolist(), i_a2.tolist()))
     asserted, producible = register_value_sets(triples)
     dead = producible - asserted - {NONE_VAL}
     if len(dead) > 1:
@@ -415,12 +431,11 @@ def _pack_register_history(history, adapter) -> Packed:
     cap = np.searchsorted(inv, ret, side="left") - 1      # inv[j] < ret[i], j != i
 
     # lo[d] = first rank that can still be absent from a depth-d prefix
-    lo = np.zeros(R + 1, dtype=np.int64)
-    p = 0
-    for d in range(R + 1):
-        while p < R and cap[p] < d:
-            p += 1
-        lo[d] = p
+    # = length of the longest prefix with cap < d, i.e. the insertion
+    # point of d in the (non-decreasing) running prefix max of cap
+    lo = np.searchsorted(np.maximum.accumulate(cap), np.arange(R + 1),
+                         side="left").astype(np.int64) if R \
+        else np.zeros(1, dtype=np.int64)
     # feasibility: window must hold all set bits and all enabled
     # candidates. Histories needing >32 bits get the wider multi-word
     # kernel variants (W=64/128); >128 is beyond the kernel.
@@ -468,8 +483,7 @@ def _pack_register_history(history, adapter) -> Packed:
                        np.where(f == READ, ver, ver - 1)).astype(np.int32)
     ceil_frame = np.where(in_range, ceiling[idx], CEIL_INF)   # [R, W]
     suffix_min = np.full(R + 1, CEIL_INF, dtype=np.int32)
-    for i in range(R - 1, -1, -1):
-        suffix_min[i] = min(suffix_min[i + 1], ceiling[i])
+    suffix_min[:R] = np.minimum.accumulate(ceiling[::-1])[::-1]
     ceil_beyond = suffix_min[np.minimum(lo[:R] + w, R)]       # [R]
 
     # info predecessor tables: info j enabled at depth d iff every
@@ -1021,7 +1035,8 @@ def _batched_kernel_jitted(f_max: int, w: int):
     return jax.jit(jax.vmap(kernel))
 
 
-def check_packed_batch(packs: list, f_max: Optional[int] = None) -> list:
+def check_packed_batch(packs: list, f_max: Optional[int] = None,
+                       try_fused: bool = True) -> list:
     """Check K per-key packed histories in vmapped kernel launches.
 
     This is the production key-level data-parallel axis (SURVEY §2.3; the
@@ -1048,8 +1063,11 @@ def check_packed_batch(packs: list, f_max: Optional[int] = None) -> list:
     # overflowing keys fall through to the vmapped jnp path / ladder.
     # f_max set means the caller chose a rung past the fused capacity
     # 32 — the kernel would only overflow again (same guard as
-    # check_packed's single-history path).
-    if f_max is None:
+    # check_packed's single-history path). try_fused=False means the
+    # caller already ran the fused batch itself (the overlapped
+    # pack-and-launch path in TPULinearizableChecker.check_batch) and
+    # these packs are its leftovers.
+    if f_max is None and try_fused:
         from . import wgl_mxu
         mxu_out = _run_fused(_mxu_broken, "mxu batch",
                              lambda: wgl_mxu.check_packed_batch_mxu(packs))

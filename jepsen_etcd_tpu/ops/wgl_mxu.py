@@ -76,6 +76,12 @@ SCAL_COLS = 8
 #: comfortably (<= ~34 MB bf16 at w=64, x the build's 16-key vmap
 #: chunk ~0.5 GB transient); deeper histories keep the serial gather
 OH_MAX_RPAD = 512
+#: keys per batched dispatch. Measured r5: each pallas launch carries
+#: ~57 ms of fixed cost through the tunnel, which exceeds anything a
+#: finer chunk overlap can hide — so chunks only bound the padded
+#: k_pad blowup of truly huge batches, and a (bucket, width) group
+#: normally launches ONCE
+BATCH_CHUNK = 1024
 
 U16_NOASSERT = 65535
 U16_INF = 65534
@@ -798,47 +804,63 @@ def check_packed_mxu(p: Packed) -> dict | None:
     return _decode(out, p)
 
 
-def check_packed_batch_mxu(packs: list) -> list | None:
-    """Check many packed histories in ONE pallas dispatch per
-    (R-bucket, window-width) group. Returns per-pack results aligned
-    with input order; packs the kernel can't take (wide window, info
-    ops, id overflow) get None entries for the caller's per-key
-    fallback. Returns None outright when NO pack is supported."""
+def launch_packed_batch_mxu(packs: list) -> list:
+    """Stage + asynchronously launch the supported packs, one pallas
+    dispatch per (R-bucket, window-width, BATCH_CHUNK) chunk. Returns a
+    list of (index_chunk, device_future, pack_chunk) launch records for
+    ``collect_packed_batch_mxu``: all launches go out before any
+    readback, so a multi-group batch pays one synchronization total."""
     import jax
     import jax.numpy as jnp
 
-    if not packs or not any(supported(p) for p in packs):
-        return None
     interpret = jax.default_backend() != "tpu"
-    results: list = [None] * len(packs)
     groups: dict = {}
     for i, p in enumerate(packs):
         if supported(p):
             groups.setdefault((max(bucket(p.R), TSUB), p.w), []).append(i)
-    # launch every (bucket, width) group BEFORE reading any back: the
-    # dispatches pipeline on device, so the batch pays one tunnel
-    # round trip total instead of one per group
     launched = []
     for (r_pad, wk), idxs in groups.items():
-        # bucket the key count so the jit cache holds O(log K) variants
-        # instead of one compile per distinct batch size; padding keys
-        # are all-zero (R=0) rows whose grid steps die immediately
-        K = len(idxs)
-        k_pad = 1
-        while k_pad < K:
-            k_pad *= 2
-        i32s = np.zeros((k_pad, r_pad, 4), dtype=np.int32)
-        u16s = np.zeros((k_pad, r_pad, 12), dtype=np.uint16)
-        for j, i in enumerate(idxs):
-            a, b = pack_perop(packs[i], r_pad)
-            i32s[j] = a
-            u16s[j] = b
-        dev = _call_batch(k_pad, r_pad, wk, interpret)(
-            jnp.asarray(i32s.reshape(k_pad * r_pad, 4)),
-            jnp.asarray(u16s.reshape(k_pad * r_pad, 12)))
-        launched.append((idxs, dev))
-    for idxs, dev in launched:
+        for lo_i in range(0, len(idxs), BATCH_CHUNK):
+            chunk = idxs[lo_i:lo_i + BATCH_CHUNK]
+            # bucket the chunk count so the jit cache holds O(log K)
+            # variants instead of one compile per distinct batch size;
+            # padding keys are all-zero (R=0) rows whose grid steps die
+            # at the first frontier-death check
+            K = len(chunk)
+            k_pad = 1
+            while k_pad < K:
+                k_pad *= 2
+            i32s = np.zeros((k_pad, r_pad, 4), dtype=np.int32)
+            u16s = np.zeros((k_pad, r_pad, 12), dtype=np.uint16)
+            for j, i in enumerate(chunk):
+                a, b = pack_perop(packs[i], r_pad)
+                i32s[j] = a
+                u16s[j] = b
+            dev = _call_batch(k_pad, r_pad, wk, interpret)(
+                jnp.asarray(i32s.reshape(k_pad * r_pad, 4)),
+                jnp.asarray(u16s.reshape(k_pad * r_pad, 12)))
+            launched.append((chunk, dev, [packs[i] for i in chunk]))
+    return launched
+
+
+def collect_packed_batch_mxu(launched: list, results: list) -> None:
+    """Read back launch records from ``launch_packed_batch_mxu`` and
+    decode into ``results`` (indexed as the original pack list)."""
+    for chunk, dev, chunk_packs in launched:
         out = np.asarray(dev)
-        for j, i in enumerate(idxs):
-            results[i] = _decode(out[j], packs[i])
+        for j, (i, p) in enumerate(zip(chunk, chunk_packs)):
+            results[i] = _decode(out[j], p)
+
+
+def check_packed_batch_mxu(packs: list) -> list | None:
+    """Check many packed histories in ONE pallas dispatch per
+    (R-bucket, window-width) chunk, all launched before any readback.
+    Returns per-pack results aligned with input order; packs the
+    kernel can't take (wide window, info ops, id overflow) get None
+    entries for the caller's per-key fallback. Returns None outright
+    when NO pack is supported."""
+    if not packs or not any(supported(p) for p in packs):
+        return None
+    results: list = [None] * len(packs)
+    collect_packed_batch_mxu(launch_packed_batch_mxu(packs), results)
     return results

@@ -45,9 +45,9 @@ def test_16_keys_single_batched_launch(monkeypatch):
     real_batch = wgl.check_packed_batch
     real_single = wgl.check_packed
 
-    def spy_batch(packs, f_max=None):
+    def spy_batch(packs, f_max=None, **kw):
         calls["batch"] += 1
-        return real_batch(packs, f_max=f_max)
+        return real_batch(packs, f_max=f_max, **kw)
 
     def spy_single(p, f_max=None):
         calls["single"] += 1
@@ -124,9 +124,9 @@ def test_compose_forwards_batch(monkeypatch):
     calls = {"batch": 0}
     real_batch = wgl.check_packed_batch
 
-    def spy(packs, f_max=None):
+    def spy(packs, f_max=None, **kw):
         calls["batch"] += 1
-        return real_batch(packs, f_max=f_max)
+        return real_batch(packs, f_max=f_max, **kw)
 
     monkeypatch.setattr(wgl, "check_packed_batch", spy)
     rng = random.Random(3)
