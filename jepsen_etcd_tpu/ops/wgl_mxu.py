@@ -756,6 +756,68 @@ def _call_batch(k_keys: int, r_pad: int, wk: int, interpret: bool):
     return jax.jit(run)
 
 
+@functools.lru_cache(maxsize=None)
+def _call_batch_sharded(k_pad: int, r_pad: int, wk: int, n_dev: int,
+                        interpret: bool):
+    """The multi-chip form of the fused batch: shard_map over a
+    ("key",) device mesh, each device running the SAME one-dispatch
+    pallas batch on its k_pad/n_dev key shard. Keys are independent,
+    so the layout is a pure scatter — no collectives ride the ICI —
+    which is exactly SURVEY §2.3's key-level DP axis for the
+    production fast path (a v5e-8 runs 8 one-chip dispatches
+    concurrently instead of queueing one)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    assert k_pad % n_dev == 0
+    per = _call_batch(k_pad // n_dev, r_pad, wk, interpret)
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("key",))
+    sharded = shard_map(
+        per,
+        mesh=mesh,
+        in_specs=(P("key"), P("key")),
+        out_specs=P("key"),
+        # the pallas_call inside can't annotate varying-mesh-axes on
+        # its out_shape; every output IS per-shard (key-varying)
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+def _batch_geometry(K: int):
+    """(k_pad, n_dev) for a K-key chunk: with one device, bucket to the
+    next power of two (bounds the jit cache at O(log K) variants);
+    with a mesh, pad the key axis to pow2-bucketed keys PER DEVICE
+    times every visible device — all devices shard, any device count,
+    and padding keys are zero rows whose grid steps die at the first
+    frontier-death check (the same layout rule as the jnp path's
+    _check_bucket_group)."""
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        per_dev = 1
+        while per_dev * n_dev < K:
+            per_dev *= 2
+        return per_dev * n_dev, n_dev
+    k_pad = 1
+    while k_pad < K:
+        k_pad *= 2
+    return k_pad, 1
+
+
+def _batch_call_for(k_pad: int, r_pad: int, wk: int, n_dev: int,
+                    interpret: bool):
+    """The single-device or mesh-sharded fused batch entry for a
+    geometry from ``_batch_geometry`` (the flattened (k_pad * r_pad,
+    ...) inputs are key-major, so an even axis-0 split IS a key
+    split)."""
+    if n_dev > 1:
+        return _call_batch_sharded(k_pad, r_pad, wk, n_dev, interpret)
+    return _call_batch(k_pad, r_pad, wk, interpret)
+
+
 def _summarize(jnp, out):
     """Fold the per-key (32, 128) flag block into 4 per-key scalars
     [accepted, overflowed, peak, waves] ON DEVICE. The raw block is
@@ -826,17 +888,14 @@ def launch_packed_batch_mxu(packs: list) -> list:
             # variants instead of one compile per distinct batch size;
             # padding keys are all-zero (R=0) rows whose grid steps die
             # at the first frontier-death check
-            K = len(chunk)
-            k_pad = 1
-            while k_pad < K:
-                k_pad *= 2
+            k_pad, n_dev = _batch_geometry(len(chunk))
             i32s = np.zeros((k_pad, r_pad, 4), dtype=np.int32)
             u16s = np.zeros((k_pad, r_pad, 12), dtype=np.uint16)
             for j, i in enumerate(chunk):
                 a, b = pack_perop(packs[i], r_pad)
                 i32s[j] = a
                 u16s[j] = b
-            dev = _call_batch(k_pad, r_pad, wk, interpret)(
+            dev = _batch_call_for(k_pad, r_pad, wk, n_dev, interpret)(
                 jnp.asarray(i32s.reshape(k_pad * r_pad, 4)),
                 jnp.asarray(u16s.reshape(k_pad * r_pad, 12)))
             launched.append((chunk, dev, [packs[i] for i in chunk]))

@@ -182,3 +182,33 @@ def test_unsupported_shapes_return_none():
     p = wgl.Packed(ok=False, reason="nope")
     assert wgl_mxu.check_packed_mxu(p) is None
     assert wgl_mxu.supported(p) is False
+
+
+def test_batch_shards_over_device_mesh():
+    """With >1 visible device the fused batch runs through shard_map
+    over the ("key",) mesh: output shards land one per device and the
+    verdicts match the CPU oracle (SURVEY §2.3: the production fast
+    path's key axis is mesh-sharded)."""
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        import pytest
+        pytest.skip("needs a multi-device mesh")
+    rng = random.Random(7)
+    packs, hs = [], []
+    while len(packs) < 2 * n_dev:
+        h = gen_history(rng, n_procs=3, n_ops=30)
+        p = wgl.pack_register_history(h)
+        if p.ok and wgl_mxu.supported(p):
+            packs.append(p)
+            hs.append(h)
+    launched = wgl_mxu.launch_packed_batch_mxu(packs)
+    outs = [None] * len(packs)
+    wgl_mxu.collect_packed_batch_mxu(launched, outs)
+    assert max(len(dev.sharding.device_set)
+               for _, dev, _ in launched) == n_dev
+    for h, out in zip(hs, outs):
+        assert out is not None and out["engine"] == "mxu-wave"
+        cpu = check_history(VersionedRegister(), h)
+        assert out["valid?"] == cpu["valid?"], (out, cpu, h.to_jsonl())
