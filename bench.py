@@ -274,29 +274,74 @@ def bench_engine_crossover():
 
 def bench_deep_wgl():
     """Config #2: concurrency 4n (=20), ops-per-key 2000 — deep
-    permutation search; records peak frontier + spill stats."""
+    permutation search (BFS peak frontier ~252). r5 routes this cell
+    through PRODUCTION and reports every engine head-to-head: the
+    native DFS walks a near-linear witness (~3k configs) where the
+    pinned r4 ladder paid 1.2 s of per-wave dispatch, so the router's
+    size-cutoff (entries 5.2k < DFS_FIRST_MAX) is the measured winner.
+    An exhaustion adversarial (read asserting an unreachable version
+    appended at the end) checks the invalid polarity stays routed."""
+    from jepsen_etcd_tpu.core.op import Op
+    from jepsen_etcd_tpu.core.history import History
     from jepsen_etcd_tpu.ops import wgl
+    from jepsen_etcd_tpu.checkers.linearizable import check_history
+    from jepsen_etcd_tpu.checkers.tpu_linearizable import (
+        TPULinearizableChecker)
+    from jepsen_etcd_tpu.models import VersionedRegister
     t0 = time.time()
     h = sim_register_history(2600, 20, seed=5, name="bench-register-deep")
     gen_s = time.time() - t0
     p = wgl.pack_register_history(h)
     assert p.ok, p.reason
-    # deep searches overflow the 32/128 rungs immediately; start at 256
-    # (fits the measured peak 252; see the LADDER comment) to skip two
-    # heavy w=64 compiles in the warmup
+
+    t0 = time.time()
+    nat = check_history(VersionedRegister(), h)
+    native_s = time.time() - t0
+    assert nat["valid?"] is True, nat
+    # the ladder needs the 256 rung (peak 252); warm the compile
     wgl.check_packed(p, f_max=256)
     t0 = time.time()
-    out = wgl.check_packed(p, f_max=256)
-    dt = time.time() - t0
-    note(f"deep 4n/2000: verdict={out['valid?']} w={p.w} "
-         f"peak={out.get('peak-frontier')} spilled={out.get('spilled')} "
-         f"in {dt:.3f}s")
+    lad = wgl.check_packed(p, f_max=256)
+    ladder_s = time.time() - t0
+    assert lad["valid?"] is True, lad
+    prod = TPULinearizableChecker()
+    prod.check({}, h)
+    t0 = time.time()
+    out = prod.check({}, h)
+    prod_s = time.time() - t0
     assert out["valid?"] is True, out
-    return {"value": round(dt, 4), "unit": "s", "gen_s": round(gen_s, 2),
+
+    # adversarial: an end-appended read asserting an unreachable
+    # version — every engine must answer False, routed production too
+    ops = list(h)
+    vmax = max((o["value"][0] or 0) for o in ops
+               if o.get("type") == "ok"
+               and isinstance(o.get("value"), (list, tuple))
+               and o["value"] and isinstance(o["value"][0], int))
+    ops.append(Op(type="invoke", process=19, f="read",
+                  value=[None, None], index=len(ops), time=10 ** 15))
+    ops.append(Op(type="ok", process=19, f="read",
+                  value=[vmax + 7, None], index=len(ops),
+                  time=10 ** 15 + 1))
+    hb = History(ops)
+    t0 = time.time()
+    adv = prod.check({}, hb)
+    adv_s = time.time() - t0
+    assert adv["valid?"] is False, adv
+
+    note(f"deep 4n/2000: native={native_s:.3f}s ladder={ladder_s:.3f}s "
+         f"production={prod_s:.3f}s ({out.get('checker')}) "
+         f"adversarial={adv_s:.3f}s peak={lad.get('peak-frontier')}")
+    return {"value": round(prod_s, 4), "unit": "s",
+            "gen_s": round(gen_s, 2),
             "ops": p.R, "w": p.w,
-            "peak_frontier": out.get("peak-frontier"),
-            "spilled": bool(out.get("spilled")),
-            "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
+            "native_s": round(native_s, 4),
+            "ladder_s": round(ladder_s, 4),
+            "production_s": round(prod_s, 4),
+            "production_engine": out.get("checker"),
+            "adversarial_s": round(adv_s, 4),
+            "peak_frontier": lad.get("peak-frontier"),
+            "vs_baseline": round(BASELINE_SECONDS / max(prod_s, 1e-9), 1)}
 
 
 def bench_batched_keys():

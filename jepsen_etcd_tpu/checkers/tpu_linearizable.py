@@ -46,6 +46,17 @@ CPU_CUTOFF = 512
 #: 0.135 s — same crossover region, so one constant serves both.
 #: The kernel's floor is the axon tunnel round trip (~0.1 s); the
 #: DFS's curve is ~quadratic. They cross at ~13k entries.
+#:
+#: Search DIFFICULTY is measured not to need its own routing
+#: dimension below this cutoff (r5, deep 4n/2000 cell: 5.2k entries,
+#: BFS peak frontier 252): the memoized DFS walks a near-linear
+#: witness on the valid history (3,044 configs, 0.035 s vs the
+#: ladder's 1.22 s), and on corrupted-read / unreachable-version
+#: adversarials the version-determinism of the register model
+#: collapses the refutation to ~18.5k configs (0.05 s). Pathological
+#: cases the prediction misses are bounded by the band's 4n+10k
+#: config budget (~one kernel-run of waste) before the kernel takes
+#: over — exhaustion-priced, not predicted.
 DFS_FIRST_MAX = 13_000
 
 #: batched key-DP crossover: below this many entries PER KEY a batch

@@ -795,7 +795,7 @@ def _batch_geometry(K: int):
     _check_bucket_group)."""
     import jax
 
-    n_dev = len(jax.devices())
+    n_dev = min(len(jax.devices()), K)  # never pad a tiny chunk wider
     if n_dev > 1:
         per_dev = 1
         while per_dev * n_dev < K:
