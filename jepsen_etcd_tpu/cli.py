@@ -53,13 +53,15 @@ def build_parser() -> argparse.ArgumentParser:
         s.add_argument("--serializable", action="store_true")
         s.add_argument("--lazyfs", action="store_true")
         s.add_argument("--client-type", default="direct",
-                       choices=["direct", "etcdctl", "http"],
+                       choices=["direct", "etcdctl", "http", "grpc"],
                        help="direct/etcdctl drive the simulated cluster; "
                             "http drives a LIVE etcd over its v3 JSON "
-                            "gateway (etcd.clj:246-257)")
+                            "gateway, grpc over native gRPC — the "
+                            "reference's wire protocol "
+                            "(etcd.clj:246-257, client.clj:14-68)")
         s.add_argument("--endpoint", default="http://127.0.0.1:2379",
                        help="comma-separated live-etcd endpoint URLs "
-                            "(only with --client-type http); each "
+                            "(only with --client-type http/grpc); each "
                             "endpoint is a node")
         s.add_argument("--snapshot-count", type=int, default=100)
         s.add_argument("--unsafe-no-fsync", action="store_true",
@@ -91,6 +93,9 @@ def build_parser() -> argparse.ArgumentParser:
                              "backed by the simulated MVCC store (the "
                              "real-etcd adapter's hermetic test double)")
     gw.add_argument("-p", "--port", type=int, default=2379)
+    gw.add_argument("--grpc", action="store_true",
+                    help="serve native gRPC (etcdserverpb) instead of "
+                         "the JSON gateway")
     return p
 
 
@@ -110,7 +115,7 @@ def parse_nemesis_spec(spec: str) -> list[str]:
 
 
 def opts_from_args(args) -> dict:
-    if args.client_type == "http":
+    if args.client_type in ("http", "grpc"):
         # live mode: nodes ARE the endpoint URLs
         nodes = [e.strip() for e in args.endpoint.split(",") if e.strip()]
     else:
@@ -182,9 +187,22 @@ def main(argv=None) -> int:
         from .serve import serve_store
         return serve_store(args.store, args.port, args.bind)
     if args.command == "gateway":
+        log = logging.getLogger("jepsen_etcd_tpu")
+        if args.grpc:
+            import time as _time
+            from .sut.grpc_gateway import serve_grpc
+            srv, _state, port = serve_grpc(args.port)
+            log.info("etcd v3 gRPC gateway on 127.0.0.1:%d (sim store)",
+                     port)
+            try:
+                while True:
+                    _time.sleep(3600)
+            except KeyboardInterrupt:
+                srv.stop(0)
+            return 0
         from .sut.http_gateway import serve as gw_serve
         srv, _state = gw_serve(args.port)
-        logging.getLogger("jepsen_etcd_tpu").info(
+        log.info(
             "etcd v3 gateway on http://127.0.0.1:%d (sim store)",
             srv.server_address[1])
         try:

@@ -18,6 +18,11 @@ def client(test, node: str):
         # node IS its endpoint URL
         from .etcd_http import HttpEtcdClient
         return HttpEtcdClient(node)
+    if ctype == "grpc":
+        # live-etcd mode over native gRPC — the reference's wire
+        # protocol (jetcd, client.clj:14-68)
+        from .etcd_grpc import GrpcEtcdClient
+        return GrpcEtcdClient(node)
     cluster = test["cluster"]
     if ctype == "direct":
         return DirectClient(cluster, node)

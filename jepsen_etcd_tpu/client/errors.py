@@ -35,3 +35,20 @@ async def with_errors(op: Op, idempotent: Iterable[str],
         return op.evolve(type=t, error=e.as_error_value())
     except Cancelled:
         raise
+
+
+def remap_etcd_message(msg: str):
+    """etcd hides specific conditions under generic gRPC codes
+    (client.clj:302-353); both live adapters must remap by message
+    text FIRST, identically, or the same server fault would classify
+    differently per --client-type. Returns a SimError or None."""
+    low = msg.lower()
+    if "leader changed" in low:
+        return SimError("leader-changed", msg)
+    if "raft: stopped" in low:
+        return SimError("raft-stopped", msg)
+    if "lease not found" in low:
+        return SimError("lease-not-found", msg)
+    if "compacted" in low:
+        return SimError("compacted", msg)
+    return None

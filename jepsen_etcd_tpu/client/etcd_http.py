@@ -32,6 +32,7 @@ import urllib.request
 from ..runner.sim import current_loop, wait_for, SECOND
 from ..sut.errors import SimError
 from ..sut.store import Txn
+from .errors import remap_etcd_message
 from .base import Client, TIMEOUT, txn_result
 
 _TARGETS = {"value": ("VALUE", "value"),
@@ -101,17 +102,12 @@ def _classify_http_error(e: BaseException) -> SimError:
             body = {}
         code = int(body.get("code", -1))
         msg = body.get("message") or body.get("error") or str(e)
-        low = msg.lower()
-        # message remaps FIRST (client.clj:302-353): etcd hides
-        # specific conditions under generic gRPC codes
-        if "leader changed" in low:
-            return SimError("leader-changed", msg)
-        if "raft: stopped" in low:
-            return SimError("raft-stopped", msg)
-        if "lease not found" in low:
-            return SimError("lease-not-found", msg)
-        if "compacted" in low:
-            return SimError("compacted", msg)
+        # message remaps FIRST (client.clj:302-353), shared with the
+        # native-gRPC adapter so the same server fault classifies
+        # identically per --client-type
+        remapped = remap_etcd_message(msg)
+        if remapped is not None:
+            return remapped
         if code in _GRPC_CODES:
             return SimError(_GRPC_CODES[code], msg)
         return SimError("unavailable", msg, definite=False)

@@ -55,14 +55,14 @@ def etcd_test(opts: dict) -> dict:
         o["concurrency"] = 2 * n
     wl_fn = workloads()[o["workload"]]
     workload = wl_fn(o)
-    live = o["client_type"] == "http"
+    live = o["client_type"] in ("http", "grpc")
     if live and o["nemesis"]:
         # the reference faults real nodes over SSH (db.clj); live mode
         # has only the client wire, so faults stay a sim capability
         raise ValueError(
-            "live mode (--client-type http) has no control plane for "
-            f"faults {o['nemesis']}; drop --nemesis or use the simulated "
-            "cluster")
+            f"live mode (--client-type {o['client_type']}) has no "
+            f"control plane for faults {o['nemesis']}; drop --nemesis "
+            "or use the simulated cluster")
     if live:
         from .db.live import live_db
         o["db"] = live_db(o)

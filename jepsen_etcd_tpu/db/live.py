@@ -16,9 +16,18 @@ from typing import Optional
 
 from ..runner.sim import current_loop, gather
 from ..sut.errors import SimError
-from ..client.etcd_http import HttpEtcdClient
 
 logger = logging.getLogger("jepsen_etcd_tpu.db")
+
+
+def _live_client_cls(opts: dict):
+    """The live client class for this run's wire protocol (http = v3
+    JSON gateway, grpc = native gRPC — the reference's protocol)."""
+    if (opts or {}).get("client_type") == "grpc":
+        from ..client.etcd_grpc import GrpcEtcdClient
+        return GrpcEtcdClient
+    from ..client.etcd_http import HttpEtcdClient
+    return HttpEtcdClient
 
 
 class LiveDb:
@@ -32,9 +41,14 @@ class LiveDb:
     async def setup(self, test: dict) -> None:
         self.members = set(test["nodes"])
         loop = current_loop()
-        clients = [HttpEtcdClient(ep) for ep in test["nodes"]]
-        await gather(*[loop.spawn(c.await_node_ready())
-                       for c in clients])
+        cls = _live_client_cls(test)
+        clients = [cls(ep) for ep in test["nodes"]]
+        try:
+            await gather(*[loop.spawn(c.await_node_ready())
+                           for c in clients])
+        finally:
+            for c in clients:  # gRPC clients own channels/threads
+                c.close()
         logger.info("live cluster ready: %s", test["nodes"])
 
     async def teardown(self, test: dict) -> None:
@@ -72,12 +86,16 @@ class LiveDb:
         """Highest-raft-term status answer wins (db.clj:38-52), mapped
         back to the endpoint whose member id is the reported leader."""
         loop = current_loop()
+        cls = _live_client_cls(self.opts)
 
         async def ask(ep):
+            c = cls(ep)
             try:
-                return ep, await HttpEtcdClient(ep).status()
+                return ep, await c.status()
             except (SimError, TimeoutError):
                 return ep, None
+            finally:
+                c.close()
 
         answers = [a for a in await gather(
             *[loop.spawn(ask(ep)) for ep in sorted(self.members)])

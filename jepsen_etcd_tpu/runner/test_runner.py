@@ -82,7 +82,7 @@ def check_task_leaks(loop, where: str = "post-run") -> None:
 
 def run_test(test: dict) -> dict:
     """Run a composed test map; returns {valid?, results, history, dir}."""
-    if test.get("client_type") == "http":
+    if test.get("client_type") in ("http", "grpc"):
         return run_test_live(test)
     seed = test.get("seed", 0)
     loop = SimLoop(seed=seed)

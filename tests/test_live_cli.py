@@ -82,3 +82,37 @@ def test_live_db_primaries_returns_leader_endpoint(gateway):
     finally:
         set_current_loop(None)
         loop.shutdown()
+
+
+# ---- native-gRPC live mode -------------------------------------------------
+
+@pytest.fixture()
+def grpc_gateway():
+    grpc = pytest.importorskip("grpc")
+    from jepsen_etcd_tpu.sut.grpc_gateway import serve_grpc
+    srv, state, port = serve_grpc()
+    yield f"http://127.0.0.1:{port}"
+    srv.stop(0)
+
+
+def test_cli_live_register_run_grpc(grpc_gateway, tmp_path):
+    """--client-type grpc runs the same workload over native gRPC —
+    the reference's wire protocol (client.clj:14-68)."""
+    from jepsen_etcd_tpu.cli import main
+    rc = main(["test", "-w", "register", "--client-type", "grpc",
+               "--endpoint", grpc_gateway, "--time-limit", "2",
+               "-r", "25", "--store", str(tmp_path)])
+    assert rc == 0
+    run_dirs = []
+    for root, dirs, files in os.walk(tmp_path):
+        if "results.json" in files:
+            run_dirs.append(root)
+    assert len(run_dirs) == 1
+    results = json.load(open(os.path.join(run_dirs[0], "results.json")))
+    assert results["valid?"] is True
+    assert results["workload"]["valid?"] is True
+    history = open(os.path.join(run_dirs[0], "history.jsonl")).read()
+    assert history.count('"type": "ok"') > 10
+    test_json = json.load(open(os.path.join(run_dirs[0], "test.json")))
+    assert test_json["client_type"] == "grpc"
+    assert test_json["nodes"] == [grpc_gateway]
