@@ -450,6 +450,50 @@ def bench_batched_512_keys():
             "vs_baseline": round(BASELINE_SECONDS / max(prod_s, 1e-9), 1)}
 
 
+def bench_w128_deep():
+    """Four-word windows (w=128): concurrency 40 pushes the undecided
+    window past 64, the regime lock-style long-blocked ops create
+    (VERDICT r4 #6). Above the DFS crossover, so the production router
+    sends it to the fused kernel; the jnp ladder cannot answer this
+    shape at all (peak frontier ~3.4k blows through every rung) and is
+    reported as such."""
+    from jepsen_etcd_tpu.ops import wgl, wgl_mxu
+    from jepsen_etcd_tpu.checkers.linearizable import check_history
+    from jepsen_etcd_tpu.checkers.tpu_linearizable import (
+        TPULinearizableChecker)
+    from jepsen_etcd_tpu.models import VersionedRegister
+    t0 = time.time()
+    h = sim_register_history(13000, 40, seed=13, name="bench-w128-deep")
+    gen_s = time.time() - t0
+    p = wgl.pack_register_history(h)
+    assert p.ok and p.w == 128, (p.reason, p.w)
+    wgl_mxu.check_packed_mxu(p)  # warmup compile
+    t0 = time.time()
+    out = wgl_mxu.check_packed_mxu(p)
+    mxu_s = time.time() - t0
+    assert out["valid?"] is True, out
+    t0 = time.time()
+    nat = check_history(VersionedRegister(), h)
+    native_s = time.time() - t0
+    assert nat["valid?"] is True, nat
+    prod = TPULinearizableChecker()
+    prod.check({}, h)
+    t0 = time.time()
+    pr = prod.check({}, h)
+    prod_s = time.time() - t0
+    assert pr["valid?"] is True, pr
+    note(f"w128 deep: mxu={mxu_s:.3f}s native={native_s:.3f}s "
+         f"production={prod_s:.3f}s engine={pr.get('engine')} "
+         f"entries={len(h)} R={p.R}")
+    return {"value": round(prod_s, 4), "unit": "s",
+            "gen_s": round(gen_s, 2), "ops": p.R, "w": p.w,
+            "mxu_s": round(mxu_s, 4), "native_s": round(native_s, 4),
+            "production_s": round(prod_s, 4),
+            "production_engine": pr.get("engine"),
+            "ladder": "unknown (peak ~3.4k exceeds every rung)",
+            "vs_baseline": round(BASELINE_SECONDS / max(prod_s, 1e-9), 1)}
+
+
 def bench_faulted_register():
     """Register under kill+partition faults: histories carry :info
     (crashed) ops — the regime the info-op packing, symmetry classes,
@@ -592,6 +636,7 @@ def main() -> int:
     for name, fn in [("register_100", bench_register_100),
                      ("engine_crossover", bench_engine_crossover),
                      ("deep_wgl_4n_2000", bench_deep_wgl),
+                     ("w128_deep", bench_w128_deep),
                      ("faulted_register", bench_faulted_register),
                      ("batched_64_keys", bench_batched_keys),
                      ("register_50k", bench_register_50k),

@@ -204,6 +204,8 @@ class Packed:
     inv_rank: Any = None      # [R] int32 (invoke-time rank)
     ret_rank: Any = None      # [R] int32 (return-time rank)
     lo: Any = None            # [R+1] int64 (window base per depth)
+    _i_inv_rank: Any = None   # [I] int64 (info invokes on the rank scale;
+                              # ensure_frames ingredient)
 
 
 MUTEX_LOCKED = "locked"
@@ -450,24 +452,7 @@ def _pack_register_history(history, adapter) -> Packed:
     w = next(c for c in (W, 64, W_MAX) if width <= c)
     nw = w // 32
 
-    d_idx = np.arange(R)[:, None]                       # [R, 1]
-    b_idx = np.arange(w)[None, :]                       # [1, W]
-    idx = np.minimum(lo[:R][:, None] + b_idx, R - 1)    # [R, W] clamped
-    in_range = (lo[:R][:, None] + b_idx) < R
-    static_ok = in_range & (pred[idx] <= d_idx)
-
-    # predecessor bits within the frame: bit c <-> rank lo[d]+c. Masks
-    # pack into nw little-endian uint32 words (trailing axis) — TPUs
-    # have no native 64-bit ints, and W=128 exceeds uint64 anyway.
-    ret_frame = ret[idx]                                      # [R, W]
-    inv_cand = inv[idx]                                       # [R, W]
-    is_pred = (ret_frame[:, None, :] < inv_cand[:, :, None])  # [R, W, W]
-    in_range_c = in_range[:, None, :]                         # [R, 1, W]
-    pred_frame = pack_bits(is_pred & in_range_c, nw)
-
     is_upd = (f == WRITE) | (f == CAS)
-    upd_frame = is_upd[idx] & in_range
-    upd_mask = pack_bits(upd_frame, nw)
     cum_upd = np.concatenate([[0], np.cumsum(is_upd)])
     u_forced = cum_upd[lo[:R]].astype(np.int32)
 
@@ -475,34 +460,15 @@ def _pack_register_history(history, adapter) -> Packed:
     # device): op e with a version assertion can only fire while the
     # register version is <= its ceiling (read: ver, update: ver-1);
     # version never decreases, so a state whose version exceeds the
-    # min ceiling among unlinearized required ops is dead. Split into
-    # a per-window-lane table (masked per state) and a static suffix
-    # min for ranks beyond the window.
+    # min ceiling among unlinearized required ops is dead. The static
+    # suffix min covers ranks beyond the window; the per-window-lane
+    # table is a frame (lazy).
     CEIL_INF = np.int32(2 ** 30)
     ceiling = np.where(ver == NO_ASSERT, CEIL_INF,
                        np.where(f == READ, ver, ver - 1)).astype(np.int32)
-    ceil_frame = np.where(in_range, ceiling[idx], CEIL_INF)   # [R, W]
     suffix_min = np.full(R + 1, CEIL_INF, dtype=np.int32)
     suffix_min[:R] = np.minimum.accumulate(ceiling[::-1])[::-1]
     ceil_beyond = suffix_min[np.minimum(lo[:R] + w, R)]       # [R]
-
-    # info predecessor tables: info j enabled at depth d iff every
-    # required op with ret < inv_j is linearized — ranks < lo[d] are
-    # forced; ranks in [lo[d], lo[d]+W) must have their window bit set;
-    # any pred rank >= lo[d]+W cannot be linearized yet -> disabled.
-    if I:
-        pred_in_win = in_range[:, :, None] & \
-            (ret_frame[:, :, None] < i_inv[None, None, :])    # [R, W, I]
-        ipred_frame = pack_bits(
-            np.swapaxes(pred_in_win, 1, 2), nw)               # [R, I, NW]
-        pf = (ret[:, None] < i_inv[None, :])                  # [R, I]
-        cum_pf = np.concatenate([np.zeros((1, I), dtype=np.int64),
-                                 np.cumsum(pf, axis=0)])      # [R+1, I]
-        hi = np.minimum(lo[:R] + w, R)                        # [R]
-        i_static_ok = cum_pf[hi] == cum_pf[R][None, :]        # [R, I]
-    else:
-        ipred_frame = np.zeros((R, 0, nw), dtype=np.uint32)
-        i_static_ok = np.zeros((R, 0), dtype=bool)
 
     # rank-compress the int64 invoke/return times jointly: pairwise
     # comparisons (all the frames need) are order-preserved, and ranks
@@ -512,21 +478,83 @@ def _pack_register_history(history, adapter) -> Packed:
     ranks = np.empty(2 * R, dtype=np.int32)
     ranks[order] = np.arange(2 * R, dtype=np.int32)
 
-    return Packed(
+    p = Packed(
         ok=True, R=R, I=I, n_values=len(vids.rev), w=w,
         shift=(lo[1:] - lo[:-1]).astype(np.int32),
-        static_ok=static_ok,
-        f_code=f[idx].astype(np.int8),
-        a1=a1[idx], a2=a2[idx], ver=ver[idx],
-        pred_frame=pred_frame, upd_mask=upd_mask, u_forced=u_forced,
-        ceil_frame=ceil_frame, ceil_beyond=ceil_beyond,
+        u_forced=u_forced, ceil_beyond=ceil_beyond,
         C=C, ni=ni, c_f=c_f, c_a1=c_a1, c_a2=c_a2, c_size=c_size,
         c_off=c_off, c_word=c_word, c_shift=c_shift, c_mask=c_mask,
-        i_static_ok=i_static_ok, ipred_frame=ipred_frame,
         op_a1=a1, op_a2=a2, op_ver=ver, op_f=f,
         op_pred_rank=pred.astype(np.int32), op_ceiling=ceiling,
         inv_rank=ranks[:R], ret_rank=ranks[R:], lo=lo,
     )
+    # frame ingredients for ensure_frames (the [R, W(, W|I)] frames are
+    # LAZY: the fused device path rebuilds them on-chip from the per-op
+    # vectors, so materializing ~R*W^2 host bits up front would charge
+    # every production check for tables only the jnp path reads)
+    p._i_inv_rank = (np.searchsorted(
+        np.sort(all_times), i_inv, side="left").astype(np.int64)
+        if I else np.zeros(0, dtype=np.int64))
+    return p
+
+
+def ensure_frames(p: Packed) -> None:
+    """Materialize the [R, W] / [R, W, W] / [R, I] frame tables on the
+    Packed (idempotent). Consumers: pad_tables (the jnp kernel path)
+    and wgl_mxu.pack_tables (the host reference for the device-builder
+    contract test)."""
+    if p.static_ok is not None or not p.ok or p.R == 0:
+        return
+    R, w, I = p.R, p.w, p.I
+    nw = w // 32
+    lo = p.lo
+    pred = p.op_pred_rank.astype(np.int64)
+    inv = p.inv_rank.astype(np.int64)
+    ret = p.ret_rank.astype(np.int64)
+    f = p.op_f
+    d_idx = np.arange(R)[:, None]                       # [R, 1]
+    b_idx = np.arange(w)[None, :]                       # [1, W]
+    idx = np.minimum(lo[:R][:, None] + b_idx, R - 1)    # [R, W] clamped
+    in_range = (lo[:R][:, None] + b_idx) < R
+    p.static_ok = in_range & (pred[idx] <= d_idx)
+
+    # predecessor bits within the frame: bit c <-> rank lo[d]+c. Masks
+    # pack into nw little-endian uint32 words (trailing axis) — TPUs
+    # have no native 64-bit ints, and W=128 exceeds uint64 anyway.
+    ret_frame = ret[idx]                                      # [R, W]
+    inv_cand = inv[idx]                                       # [R, W]
+    is_pred = (ret_frame[:, None, :] < inv_cand[:, :, None])  # [R, W, W]
+    in_range_c = in_range[:, None, :]                         # [R, 1, W]
+    p.pred_frame = pack_bits(is_pred & in_range_c, nw)
+
+    is_upd = (f == WRITE) | (f == CAS)
+    p.upd_mask = pack_bits(is_upd[idx] & in_range, nw)
+
+    CEIL_INF = np.int32(2 ** 30)
+    p.ceil_frame = np.where(in_range, p.op_ceiling[idx], CEIL_INF)
+    p.f_code = f[idx].astype(np.int8)
+    p.a1 = p.op_a1[idx]
+    p.a2 = p.op_a2[idx]
+    p.ver = p.op_ver[idx]
+
+    # info predecessor tables: info j enabled at depth d iff every
+    # required op with ret < inv_j is linearized — ranks < lo[d] are
+    # forced; ranks in [lo[d], lo[d]+W) must have their window bit set;
+    # any pred rank >= lo[d]+W cannot be linearized yet -> disabled.
+    if I:
+        i_inv = p._i_inv_rank
+        pred_in_win = in_range[:, :, None] & \
+            (ret_frame[:, :, None] < i_inv[None, None, :])    # [R, W, I]
+        p.ipred_frame = pack_bits(
+            np.swapaxes(pred_in_win, 1, 2), nw)               # [R, I, NW]
+        pf = (ret[:, None] < i_inv[None, :])                  # [R, I]
+        cum_pf = np.concatenate([np.zeros((1, I), dtype=np.int64),
+                                 np.cumsum(pf, axis=0)])      # [R+1, I]
+        hi = np.minimum(lo[:R] + w, R)                        # [R]
+        p.i_static_ok = cum_pf[hi] == cum_pf[R][None, :]      # [R, I]
+    else:
+        p.ipred_frame = np.zeros((R, 0, nw), dtype=np.uint32)
+        p.i_static_ok = np.zeros((R, 0), dtype=bool)
 
 
 # ---------------------------------------------------------------------------
@@ -850,6 +878,7 @@ def info_dims(p: Packed) -> tuple[int, int, int]:
 def pad_tables(p: Packed, r_pad: int, info: tuple = None):
     """Pad the per-depth tables to bucketed lengths (shared by
     check_packed and the __graft_entry__ paths)."""
+    ensure_frames(p)   # frames are lazy; this path reads them
     if info is None:
         info = info_dims(p)
     c_pad, ni_pad, i_tab = info

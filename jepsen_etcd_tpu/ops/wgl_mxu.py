@@ -60,7 +60,7 @@ from .wgl import (CAS, NO_ASSERT, READ, WRITE, WILDCARD,
                   Packed, bucket)
 
 F = 32            # frontier capacity (states)
-W_SUPPORTED = (32, 64)
+W_SUPPORTED = (32, 64, 128)
 TSUB = 8          # int32 table block sublane tile
 DONE_EVERY = 8    # waves between frontier-death scalar checks
 V_SENT = np.int16(-32768)   # "never matches" relative version
@@ -69,13 +69,15 @@ VAL_MAX = 2 ** 16 - 3       # value-id budget (uint16 biased +1)
 
 # table lane-segment layout (each segment is wk lanes):
 # 0: a1|a2 pair, 1: ver|ceil pair, 2..2+NW-1: pred words, last: fsk
-# int32 SMEM scal columns
-S_SHIFT, S_CEILB, S_UPD0, S_UPD1, S_R = range(5)
+# int32 SMEM scal columns (S_UPD0..S_UPD0+NW-1 hold the update-mask
+# words; NW <= 4 fits before S_R)
+S_SHIFT, S_CEILB, S_UPD0, S_UPD1, S_UPD2, S_UPD3, S_R = range(7)
 SCAL_COLS = 8
 #: largest r_pad whose (r_pad*wk, r_pad) one-hot gather matrix fits
-#: comfortably (<= ~34 MB bf16 at w=64, x the build's 16-key vmap
-#: chunk ~0.5 GB transient); deeper histories keep the serial gather
-OH_MAX_RPAD = 512
+#: comfortably, BY WIDTH: the matrix is r_pad^2*wk*2 bytes and the
+#: build vmaps 16 keys at once, so the budget halves as wk doubles
+#: (w=64: <= ~34 MB/key, ~0.5 GB per chunk; w=128 at 256: the same)
+OH_MAX_RPAD = {32: 1024, 64: 512, 128: 256}
 #: keys per batched dispatch. Measured r5: each pallas launch carries
 #: ~57 ms of fixed cost through the tunnel, which exceeds anything a
 #: finer chunk overlap can hide — so chunks only bound the padded
@@ -104,7 +106,7 @@ def _dims(wk: int):
 
 
 def supported(p: Packed) -> bool:
-    """Preconditions: packed OK, one- or two-word window, no info ops,
+    """Preconditions: packed OK, one/two/four-word window, no info ops,
     value ids and history length within the uint16 shipping budget
     (others fall back to the jnp ladder). The shift bound guards the
     uint16 C_SHIFT column of the host/device bit-identity contract:
@@ -124,6 +126,8 @@ def pack_tables(p: Packed, r_pad: int):
     maps to the never-matching -32767; ceilings prune via
     version <= ceil with version in [0, wk], so values clamp into
     [-1, wk+1]."""
+    from .wgl import ensure_frames
+    ensure_frames(p)   # frames are lazy; this host reference reads them
     R, wk = p.R, p.w
     nw, nr, np_, segk, pl, tlanes = _dims(wk)
     uf = p.u_forced.astype(np.int64)                      # [R]
@@ -228,7 +232,7 @@ def _build_tables_one(jnp, lax, i32, u16, r_pad: int, wk: int):
     in_range = (pos < R) & (kr < R)
     idx = jnp.clip(pos, 0, jnp.maximum(R - 1, 0))
 
-    if r_pad <= OH_MAX_RPAD:
+    if r_pad <= OH_MAX_RPAD[wk]:
         # one-hot gather: limb columns (values 0..255, bf16-exact) for
         # the six u16 cols (2 limbs) and the two time-rank cols
         # (3 limbs: ranks < 65000 * 2 < 2^18)
@@ -304,7 +308,7 @@ def _build_tables_one(jnp, lax, i32, u16, r_pad: int, wk: int):
     inrow = kr[:, 0] < R
     cols = [jnp.where(inrow, u[:, C_SHIFT], 0),
             jnp.where(inrow, relb, 0)]
-    for wi in range(2):
+    for wi in range(4):
         if wi < nw:
             cols.append(jnp.where(
                 inrow, lax.bitcast_convert_type(ums[wi], jnp.int32), 0))
@@ -316,8 +320,8 @@ def _build_tables_one(jnp, lax, i32, u16, r_pad: int, wk: int):
     return tab, scal
 
 
-def _wave_body(jnp, lax, pl_mod, pltpu, wk, row_t, shift, ceilb, upd0,
-               upd1, kk, R, stw_p, stv_p, alive_p, xs, rs, acc_p,
+def _wave_body(jnp, lax, pl_mod, pltpu, wk, row_t, shift, ceilb, upds,
+               kk, R, stw_p, stv_p, alive_p, xs, rs, acc_p,
                ovf_p, peak_p, wav_p, mseg_p, plane_p):
     """One BFS wave on the packed planes. No vector->scalar syncs.
 
@@ -333,7 +337,7 @@ def _wave_body(jnp, lax, pl_mod, pltpu, wk, row_t, shift, ceilb, upd0,
     lane = lax.broadcasted_iota(jnp.int32, (nr, 128), 1)
     o = lane % wk                        # window op index per slot
     obit = o % 32                        # bit within its mask word
-    o_hi = o >= 32                       # True: bit lives in word 1
+    o_word = o // 32                     # which mask word holds the bit
 
     def seg(j):
         s = row_t[:, wk * j:wk * j + wk]
@@ -343,7 +347,16 @@ def _wave_body(jnp, lax, pl_mod, pltpu, wk, row_t, shift, ceilb, upd0,
             while d < 128:
                 s = s | pltpu.roll(s, d, 1)
                 d += wk
-        return jnp.broadcast_to(s, (nr, 128))
+            return jnp.broadcast_to(s, (nr, 128))
+        # wk == 128: Mosaic rejects broadcasting a column-slice of the
+        # dynamically-offset row (invalid input layout); replicate down
+        # the sublanes with log2(nr) roll-ors instead
+        buf = jnp.pad(s, ((0, nr - 1), (0, 0)))
+        d = 1
+        while d < nr:
+            buf = buf | pltpu.roll(buf, d, 0)
+            d *= 2
+        return buf
 
     g_av = seg(0)
     g_vc = seg(1)
@@ -361,18 +374,17 @@ def _wave_body(jnp, lax, pl_mod, pltpu, wk, row_t, shift, ceilb, upd0,
     alive = alive_p[...] != 0
 
     osafe = obit.astype(jnp.uint32)
-    if nw == 1:
-        mybits = sw[0] >> osafe
-    else:
-        mybits = jnp.where(o_hi, sw[1] >> osafe, sw[0] >> osafe)
+    mybits = sw[0] >> osafe
+    for wi in range(1, nw):
+        mybits = jnp.where(o_word == wi, sw[wi] >> osafe, mybits)
     not_set = (mybits & jnp.uint32(1)) == 0
     preds_in = (sw[0] & pmask[0]) == pmask[0]
     version = lax.population_count(
-        sw[0] & jnp.uint32(upd0)).astype(jnp.int32)
-    if nw == 2:
-        preds_in = preds_in & ((sw[1] & pmask[1]) == pmask[1])
+        sw[0] & jnp.uint32(upds[0])).astype(jnp.int32)
+    for wi in range(1, nw):
+        preds_in = preds_in & ((sw[wi] & pmask[wi]) == pmask[wi])
         version = version + lax.population_count(
-            sw[1] & jnp.uint32(upd1)).astype(jnp.int32)
+            sw[wi] & jnp.uint32(upds[wi])).astype(jnp.int32)
     # per-STATE ceiling prune: a state dies when any not-yet-linearized
     # window op has rceil < version (equivalently version > the segment
     # min ceiling). version is constant across a state's wk-lane
@@ -395,11 +407,8 @@ def _wave_body(jnp, lax, pl_mod, pltpu, wk, row_t, shift, ceilb, upd0,
     model_ok = read_ok | is_write | (is_cas & (a1 == sv))
 
     bitb = jnp.uint32(1) << osafe
-    if nw == 1:
-        nwf = [sw[0] | bitb]
-    else:
-        nwf = [sw[0] | jnp.where(o_hi, jnp.uint32(0), bitb),
-               sw[1] | jnp.where(o_hi, bitb, jnp.uint32(0))]
+    nwf = [sw[wi] | jnp.where(o_word == wi, bitb, jnp.uint32(0))
+           for wi in range(nw)] if nw > 1 else [sw[0] | bitb]
     # slide: the `shift` lowest bits of the (nw*32)-bit window fall off
     # and must all be set; per-word low masks with clamped shifts
     sh = shift
@@ -411,21 +420,28 @@ def _wave_body(jnp, lax, pl_mod, pltpu, wk, row_t, shift, ceilb, upd0,
                          (jnp.uint32(1) << ks) - jnp.uint32(1))
 
     slide_ok = (nwf[0] & low_mask(0)) == low_mask(0)
-    if nw == 2:
-        slide_ok = slide_ok & ((nwf[1] & low_mask(1)) == low_mask(1))
-    # shifted window: (hi:lo) >> sh, word-wise with clamped amounts
-    s0 = jnp.minimum(sh, 31).astype(jnp.uint32)
-    if nw == 1:
-        new_w = [jnp.where(sh >= 32, jnp.uint32(0), nwf[0] >> s0)]
-    else:
-        s32 = jnp.clip(sh - 32, 0, 31).astype(jnp.uint32)
-        upshift = jnp.clip(32 - sh, 1, 31).astype(jnp.uint32)
-        lo_small = (nwf[0] >> s0) | jnp.where(
-            sh == 0, jnp.uint32(0), nwf[1] << upshift)
-        lo2 = jnp.where(sh >= 64, jnp.uint32(0),
-                        jnp.where(sh >= 32, nwf[1] >> s32, lo_small))
-        hi2 = jnp.where(sh >= 32, jnp.uint32(0), nwf[1] >> s0)
-        new_w = [lo2, hi2]
+    for wi in range(1, nw):
+        slide_ok = slide_ok & ((nwf[wi] & low_mask(wi)) == low_mask(wi))
+    # shifted window: (w_hi..w_lo) >> sh, word-wise. sh is a per-row
+    # SCALAR, so decompose sh = 32*k_off + r_off with a where-chain
+    # over the (static, <= nw) possible word offsets and clamped lane
+    # shifts (no lane ever shifts by >= 32, which would be UB) — the
+    # generic form of the old nw<=2 special cases
+    zero_p = jnp.zeros_like(nwf[0])
+    k_off = sh // 32                     # scalar word offset, 0..nw
+    r_off = sh % 32
+    rsafe = jnp.minimum(r_off, 31).astype(jnp.uint32)
+    carry_amt = jnp.clip(32 - r_off, 1, 31).astype(jnp.uint32)
+    padded = list(nwf) + [zero_p] * (nw + 1)
+    new_w = []
+    for i in range(nw):
+        lo_w = zero_p
+        hi_w = zero_p
+        for ko in range(nw + 1):
+            lo_w = jnp.where(k_off == ko, padded[i + ko], lo_w)
+            hi_w = jnp.where(k_off == ko, padded[i + ko + 1], hi_w)
+        carry = jnp.where(r_off == 0, jnp.uint32(0), hi_w << carry_amt)
+        new_w.append((lo_w >> rsafe) | carry)
 
     valid = (alive & (fsk > 0) & not_set & preds_in
              & ver_ok & model_ok & slide_ok)
@@ -618,14 +634,13 @@ def _make_kernel(batched: bool, wk: int):
         row_t = tab_ref[pl.ds(sub, 1), :]
         shift = scal_ref[sub, S_SHIFT]
         ceilb = scal_ref[sub, S_CEILB]
-        upd0 = scal_ref[sub, S_UPD0]
-        upd1 = scal_ref[sub, S_UPD1]
+        upds = [scal_ref[sub, S_UPD0 + wi] for wi in range(nw)]
         R = scal_ref[sub, S_R]
 
         @pl.when(sm[0] == 0)
         def _wave():
             _wave_body(jnp, lax, pl, pltpu, wk, row_t, shift, ceilb,
-                       upd0, upd1, kk, R, stw_p, stv_p, alive_p, xs,
+                       upds, kk, R, stw_p, stv_p, alive_p, xs,
                        rs, acc_p, ovf_p, peak_p, wav_p, mseg_p, plane_p)
 
         # frontier-death check: one vector->scalar sync every

@@ -814,13 +814,34 @@ class Cluster:
         await sleep(self.loop.rng.randint(*self.cfg.rpc_delay))
         return out
 
+    def _committed_own_term(self, leader: Node) -> bool:
+        """Has this leader committed an entry of its OWN term? Until the
+        election noop commits, the leader's commit_index may lag entries
+        the PREVIOUS leader already acked (they are in this log by the
+        election restriction, but commit knowledge travels with later
+        appends) — serving reads before then returns applied state from
+        before those acks: a stale linearizable read. etcd refuses
+        ReadIndex until then (raft §8 / etcd server apply loop); found
+        in-harness by the register checker as a real violation (r5): a
+        2.3 s stale window after a kill+partition churn."""
+        ci = leader.commit_index
+        if ci <= leader.snap_index:
+            term = leader.snap_term if ci == leader.snap_index else 0
+        else:
+            e = leader.entry(ci)
+            term = e.term if e is not None else 0
+        return term == leader.term
+
     async def _read_index(self, leader: Node) -> None:
         """Quorum round before serving a linearizable read.
 
         This is a real heartbeat exchange, not just a reachability count:
         each contacted peer reports its term, so a stale leader (e.g. one
         just resumed from SIGSTOP while a successor was elected) is deposed
-        here instead of serving a stale read as linearizable.
+        here instead of serving a stale read as linearizable. A NEW
+        leader additionally refuses until its own-term noop commits
+        (_committed_own_term) — before that its applied state may miss
+        entries its predecessor acked.
         """
         while True:
             await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
@@ -828,6 +849,9 @@ class Cluster:
                 raise SimError("unavailable", leader.name)
             if leader.role != "leader":
                 raise SimError("leader-changed", leader.name)
+            if not self._committed_own_term(leader):
+                await sleep(self.cfg.heartbeat_interval)
+                continue
             acks = 0
             for m in leader.membership:
                 if m == leader.name:

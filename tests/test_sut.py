@@ -613,3 +613,56 @@ def test_record_file_unsynced_rewrite_preserves_damaged_durable():
     f.lose_unfsynced()                          # crash: back to disk
     _, err1 = f.read()
     assert err1 == err0                         # damage survived
+
+
+def test_new_leader_read_index_waits_for_own_term_commit(sim):
+    """A newly-elected leader must NOT serve linearizable reads until
+    its own-term noop commits: its log holds every entry the old
+    leader acked (election restriction), but commit KNOWLEDGE travels
+    with later appends, so its applied state can lag acked writes.
+    Found in-harness by the register checker (r5): a killed leader +
+    election churn produced a 2.3 s window of stale linearizable
+    reads. etcd refuses ReadIndex until the noop commits; so do we.
+
+    The lagging-leader state is manufactured directly (an acked entry
+    in the log, commit knowledge not yet arrived, leadership won) with
+    replication suppressed, so both outcomes are deterministic: the
+    pre-fix read-index serves the stale value instantly; the fixed one
+    refuses until the own-term noop could commit."""
+    loop, cluster = sim
+    from jepsen_etcd_tpu.sut.cluster import LogEntry
+
+    async def main():
+        leader = await await_leader(cluster)
+        await cluster.kv_txn("n1", put_txn("k", 1))
+        await sleep(1 * SECOND)                    # k=1 settles everywhere
+        g = next(n for n in cluster.nodes.values()
+                 if n.alive and n.name != leader.name)
+        # the predecessor acked k=2: the entry reached g's log (and a
+        # majority), but g's commit_index still points at k=1 — the
+        # exact state a fresh leader is in before its noop commits
+        e = LogEntry(index=g.last_index() + 1, term=leader.term,
+                     kind="txn", payload=put_txn("k", 2))
+        g.log.append(e)
+        g.wal_append(e)
+        cluster.kill_node(leader.name)
+        g.role = "leader"
+        g.term = leader.term + 1    # won the election; noop suppressed
+        g.leader_hint = g.name
+        read_state = {}
+
+        async def read():
+            read_state["out"] = await cluster.kv_read(g.name, "k")
+
+        task = loop.spawn(read())
+        await sleep(int(0.5 * SECOND))
+        if task.done:
+            # if a read was served in the window, it must NOT be stale
+            assert read_state["out"]["kv"]["value"] == 2, (
+                f"stale linearizable read: {read_state['out']['kv']}")
+        else:
+            # correctly refusing to serve until the own-term noop
+            # commits (replication is suppressed, so it never does)
+            task.cancel()
+
+    run(loop, main())
