@@ -260,6 +260,21 @@ class TPULinearizableChecker(Checker):
             return self._fallback_after_band(
                 history, p.reason, bool(p.blowup),
                 small_unknown, band_budget)
+        if p.I > 0 and self.cpu_cutoff and small_unknown is None \
+                and len(history) > (self.dfs_first_max or 0):
+            # info-op histories can't run fused, and the jnp ladder is
+            # MEASURED ~50x slower than the native DFS on them (r5,
+            # R=3068 / I=26 faulted key: ladder 4.1 s warm — 187 s with
+            # its per-(C, NI) compile — vs DFS 0.08 s), so the DFS-first
+            # band extends to ANY size when infos are present; the
+            # ladder stays the complete last resort
+            cpu = check_history(self.model_fn(), history,
+                                max_configs=self.FALLBACK_MAX_CONFIGS)
+            if cpu["valid?"] != "unknown":
+                cpu["checker"] = "cpu-oracle"
+                cpu["engine-route"] = "info-dfs-first"
+                return cpu
+            small_unknown, band_budget = cpu, self.FALLBACK_MAX_CONFIGS
         # with a fallback available, defer the spill BFS until the DFS
         # has had its (cheaper) shot — see _overflow
         out = wgl.check_packed(p, f_max=self.f_max,
