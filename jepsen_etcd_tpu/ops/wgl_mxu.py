@@ -232,39 +232,66 @@ def _build_tables_one(jnp, lax, i32, u16, r_pad: int, wk: int):
     in_range = (pos < R) & (kr < R)
     idx = jnp.clip(pos, 0, jnp.maximum(R - 1, 0))
 
+    # one-hot gather: limb columns (values 0..255, bf16-exact) for
+    # the six u16 cols (2 limbs) and the two time-rank cols
+    # (3 limbs: ranks < 65000 * 2 < 2^18). One-hot rows select exactly
+    # one source element, so the contraction is exact whenever the
+    # operand limbs are.
+    gather_cols = (C_VER, C_A1, C_A2, C_FSK1, C_PRED, C_CEIL)
+    limbs = []
+    for c in gather_cols:
+        limbs += [u[:, c] & 0xFF, (u[:, c] >> 8) & 0xFF]
+    for arr in (invr, retr):
+        limbs += [arr & 0xFF, (arr >> 8) & 0xFF, (arr >> 16) & 0xFF]
+    V = jnp.stack(limbs, axis=1).astype(jnp.bfloat16)   # (r_pad, 18)
+    L = len(limbs)
     if r_pad <= OH_MAX_RPAD[wk]:
-        # one-hot gather: limb columns (values 0..255, bf16-exact) for
-        # the six u16 cols (2 limbs) and the two time-rank cols
-        # (3 limbs: ranks < 65000 * 2 < 2^18)
-        gather_cols = (C_VER, C_A1, C_A2, C_FSK1, C_PRED, C_CEIL)
-        limbs = []
-        for c in gather_cols:
-            limbs += [u[:, c] & 0xFF, (u[:, c] >> 8) & 0xFF]
-        for arr in (invr, retr):
-            limbs += [arr & 0xFF, (arr >> 8) & 0xFF, (arr >> 16) & 0xFF]
-        V = jnp.stack(limbs, axis=1).astype(jnp.bfloat16)  # (r_pad, 18)
+        # short histories: ONE dense one-hot matmul
         flat = idx.reshape(r_pad * wk, 1)
         rr = lax.broadcasted_iota(jnp.int32, (r_pad * wk, r_pad), 1)
         OH = (flat == rr).astype(jnp.bfloat16)
         G = lax.dot_general(OH, V, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
-        G = G.astype(jnp.int32).reshape(r_pad, wk, len(limbs))
-
-        def g(col):
-            ci = 2 * gather_cols.index(col)
-            return G[:, :, ci] | (G[:, :, ci + 1] << 8)   # (r_pad, wk)
-
-        base = 2 * len(gather_cols)
-        invg = G[:, :, base] | (G[:, :, base + 1] << 8) \
-            | (G[:, :, base + 2] << 16)                  # (r_pad, wk)
-        retg = G[:, :, base + 3] | (G[:, :, base + 4] << 8) \
-            | (G[:, :, base + 5] << 16)
+        G = G.astype(jnp.int32).reshape(r_pad, wk, L)
     else:
-        def g(col):
-            return jnp.take(u[:, col], idx, axis=0)      # (r_pad, wk)
+        # deep histories: the dense (r_pad*wk, r_pad) one-hot is
+        # O(r_pad^2), but the gather is BANDED — window packing
+        # guarantees k - wk < lo_k <= idx[k, :] <= k + wk - 1 (clamped
+        # lanes stay within [R-wk, R-1] of their row) — so each
+        # CH-row chunk's sources live in a (CH + 2*wk)-row slice.
+        # One dynamic_slice + one small one-hot matmul per chunk under
+        # lax.scan replaces the serial per-element gather that
+        # dominated deep single-key device time (~0.12 s of the 10k
+        # cell's 0.16 s)
+        ch = min(16384 // wk, r_pad)   # one-hot stays ~(16k, ch+2wk)
+        src = ch + 2 * wk
+        n_ch = r_pad // ch
+        Vp = jnp.pad(V, ((0, 2 * wk), (0, 0)))          # slice safety
+        idx_ch = idx.reshape(n_ch, ch, wk)
 
-        invg = jnp.take(invr, idx, axis=0)
-        retg = jnp.take(retr, idx, axis=0)
+        def one_chunk(_, c):
+            start = jnp.maximum(c * ch - wk, 0)
+            vsl = lax.dynamic_slice(Vp, (start, 0), (src, L))
+            offs = (idx_ch[c] - start).reshape(ch * wk, 1)
+            rr = lax.broadcasted_iota(jnp.int32, (ch * wk, src), 1)
+            OH = (offs == rr).astype(jnp.bfloat16)
+            gc = lax.dot_general(OH, vsl, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            return None, gc.astype(jnp.int32).reshape(ch, wk, L)
+
+        _, G = lax.scan(one_chunk, None,
+                        jnp.arange(n_ch, dtype=jnp.int32))
+        G = G.reshape(r_pad, wk, L)
+
+    def g(col):
+        ci = 2 * gather_cols.index(col)
+        return G[:, :, ci] | (G[:, :, ci + 1] << 8)       # (r_pad, wk)
+
+    base = 2 * len(gather_cols)
+    invg = G[:, :, base] | (G[:, :, base + 1] << 8) \
+        | (G[:, :, base + 2] << 16)                      # (r_pad, wk)
+    retg = G[:, :, base + 3] | (G[:, :, base + 4] << 8) \
+        | (G[:, :, base + 5] << 16)
 
     fsk = jnp.where(in_range & (g(C_PRED) <= kr), g(C_FSK1), 0)
     a1p = g(C_A1)
