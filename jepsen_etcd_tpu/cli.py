@@ -63,6 +63,22 @@ def build_parser() -> argparse.ArgumentParser:
                        help="comma-separated live-etcd endpoint URLs "
                             "(only with --client-type http/grpc); each "
                             "endpoint is a node")
+        s.add_argument("--db", default=None,
+                       choices=["sim", "live", "local"],
+                       help="cluster lifecycle driver: sim (default for "
+                            "direct/etcdctl), live (external cluster at "
+                            "--endpoint, no fault control plane), local "
+                            "(spawn+supervise etcd processes on this "
+                            "machine — kill/pause/member/admin faults "
+                            "work; default for http/grpc is live)")
+        s.add_argument("--etcd-binary", default=None,
+                       help="--db local: etcd argv (shell-split). "
+                            "Default: etcd from PATH if present, else "
+                            "the bundled fake-etcd stub; 'fake' forces "
+                            "the stub")
+        s.add_argument("--etcd-data-dir", default=None,
+                       help="--db local: root for per-node data dirs "
+                            "and logs (default: a fresh temp dir)")
         s.add_argument("--snapshot-count", type=int, default=100)
         s.add_argument("--unsafe-no-fsync", action="store_true",
                        help="ask the SUT not to fsync WAL appends "
@@ -115,10 +131,13 @@ def parse_nemesis_spec(spec: str) -> list[str]:
 
 
 def opts_from_args(args) -> dict:
-    if args.client_type in ("http", "grpc"):
+    db_mode = getattr(args, "db", None)
+    if args.client_type in ("http", "grpc") and db_mode != "local":
         # live mode: nodes ARE the endpoint URLs
         nodes = [e.strip() for e in args.endpoint.split(",") if e.strip()]
     else:
+        # sim and local modes: nodes are NAMES (local maps name ->
+        # client URL in db/local.py)
         nodes = [n.strip() for n in args.nodes.split(",") if n.strip()]
     conc = args.concurrency
     if isinstance(conc, str):
@@ -138,6 +157,9 @@ def opts_from_args(args) -> dict:
         "serializable": args.serializable,
         "lazyfs": args.lazyfs,
         "client_type": args.client_type,
+        "db_mode": db_mode,
+        "etcd_binary": getattr(args, "etcd_binary", None),
+        "etcd_data_dir": getattr(args, "etcd_data_dir", None),
         "snapshot_count": args.snapshot_count,
         "unsafe_no_fsync": args.unsafe_no_fsync,
         "corrupt_check": args.corrupt_check,

@@ -13,16 +13,24 @@ def client(test, node: str):
     (mirrors the reference constructor dispatch, client.clj:210-222)."""
     ctype = (test.get("client_type") or "direct") if isinstance(test, dict) \
         else "direct"
-    if ctype == "http":
-        # live-etcd mode (etcd.clj:246-257 drives a real cluster): the
-        # node IS its endpoint URL
-        from .etcd_http import HttpEtcdClient
-        return HttpEtcdClient(node)
-    if ctype == "grpc":
-        # live-etcd mode over native gRPC — the reference's wire
-        # protocol (jetcd, client.clj:14-68)
-        from .etcd_grpc import GrpcEtcdClient
-        return GrpcEtcdClient(node)
+    if ctype in ("http", "grpc"):
+        # live-etcd mode (etcd.clj:246-257 drives a real cluster). With
+        # the local control plane (--db local) the node is a NAME and
+        # the driver owns the name -> client URL mapping; in plain live
+        # mode the node IS its endpoint URL
+        endpoint = node
+        if isinstance(test, dict) and test.get("db_mode") == "local":
+            endpoint = test["db"].client_url(node)
+        if ctype == "http":
+            from .etcd_http import HttpEtcdClient
+            c = HttpEtcdClient(endpoint)
+        else:
+            # native gRPC — the reference's wire protocol (jetcd,
+            # client.clj:14-68)
+            from .etcd_grpc import GrpcEtcdClient
+            c = GrpcEtcdClient(endpoint)
+        c.node = node  # histories and per-node stats keyed by name
+        return c
     cluster = test["cluster"]
     if ctype == "direct":
         return DirectClient(cluster, node)

@@ -71,6 +71,8 @@ _METHODS = {
                      pb.LeaseRevokeRequest, pb.LeaseRevokeResponse),
     "member_list": ("/etcdserverpb.Cluster/MemberList",
                     pb.MemberListRequest, pb.MemberListResponse),
+    "member_add": ("/etcdserverpb.Cluster/MemberAdd",
+                   pb.MemberAddRequest, pb.MemberAddResponse),
     "member_remove": ("/etcdserverpb.Cluster/MemberRemove",
                       pb.MemberRemoveRequest, pb.MemberRemoveResponse),
     "status": ("/etcdserverpb.Maintenance/Status", pb.StatusRequest,
@@ -171,22 +173,36 @@ class GrpcEtcdClient(Client):
 
     # ---- plumbing ----------------------------------------------------------
 
-    async def _call(self, name: str, req, timeout: int = TIMEOUT):
-        if not self.open:
-            raise SimError("closed-client", self.endpoint)
+    def _wall_loop(self):
+        """The current loop, asserted to be a WallLoop — the one guard
+        every real-I/O path (unary, keepalive stream, watch stream)
+        shares, so a SimLoop gets this deliberate error instead of a
+        bare AttributeError deep in a thread helper."""
         loop = current_loop()
         if not hasattr(loop, "run_in_thread"):
             raise RuntimeError("GrpcEtcdClient needs a WallLoop "
                                "(runner/wall.py): real I/O cannot run "
                                "on the virtual-time SimLoop")
-        fut = loop.run_in_thread(self._calls[name], req,
-                                 max(0.1, timeout / SECOND))
+        return loop
+
+    async def _guarded(self, fn, *args, timeout: int = TIMEOUT):
+        """Run blocking gRPC I/O on the WallLoop's thread pool with the
+        client timeout and taxonomy classification."""
+        if not self.open:
+            raise SimError("closed-client", self.endpoint)
+        loop = self._wall_loop()
+        fut = loop.run_in_thread(fn, *args)
         try:
             return await wait_for(fut, timeout)
         except (SimError, TimeoutError):
             raise
         except BaseException as e:
             raise classify_grpc_error(e) from e
+
+    async def _call(self, name: str, req, timeout: int = TIMEOUT):
+        return await self._guarded(self._calls[name], req,
+                                   max(0.1, timeout / SECOND),
+                                   timeout=timeout)
 
     def close(self) -> None:
         self.open = False
@@ -286,15 +302,8 @@ class GrpcEtcdClient(Client):
         return int(resp.TTL)
 
     async def lease_keepalive_once(self, lease_id: int) -> int:
-        loop = current_loop()
-        fut = loop.run_in_thread(self._keepalive_sync, lease_id,
-                                 max(0.1, TIMEOUT / SECOND))
-        try:
-            ttl = await wait_for(fut, TIMEOUT)
-        except (SimError, TimeoutError):
-            raise
-        except BaseException as e:
-            raise classify_grpc_error(e) from e
+        ttl = await self._guarded(self._keepalive_sync, lease_id,
+                                  max(0.1, TIMEOUT / SECOND))
         if ttl <= 0:
             raise SimError("lease-not-found", f"lease {lease_id:x}")
         return ttl * SECOND
@@ -320,7 +329,7 @@ class GrpcEtcdClient(Client):
         sim and the JSON-gateway adapter."""
         from ..sut.store import Event
 
-        loop = current_loop()
+        loop = self._wall_loop()
         stop = {"flag": False, "call": None}
         started = threading.Event()
 
@@ -378,6 +387,16 @@ class GrpcEtcdClient(Client):
                             kv=kv, prev_kv=prev, revision=rev))
                     if evs and not stop["flag"]:
                         loop.call_soon_threadsafe(on_events, evs)
+                # the stream ended with neither a cancel frame nor a
+                # local cancel: the server side went away mid-stream
+                # (killed node, closed connection). A silent return here
+                # would strand the consumer on a dead watch forever —
+                # surface it as an indefinite outage so it re-establishes
+                if not stop["flag"]:
+                    loop.call_soon_threadsafe(on_error, SimError(
+                        "unavailable",
+                        "watch stream ended without cancel (server "
+                        "went away)", definite=False))
             except BaseException as e:
                 if not stop["flag"]:
                     loop.call_soon_threadsafe(
@@ -423,16 +442,30 @@ class GrpcEtcdClient(Client):
 
     async def add_member(self, name: str) -> None:
         raise SimError("unavailable",
-                       "member add needs peer URLs: use the control "
-                       "plane for real clusters", definite=True)
+                       "member add needs peer URLs: use "
+                       "member_add_urls (the local control plane, "
+                       "db/local.py, supplies them)", definite=True)
+
+    async def member_add_urls(self, peer_urls: list[str],
+                              is_learner: bool = False) -> dict:
+        """Real member add (MemberAdd, client.clj:615-622 analog): the
+        caller — the local control plane — knows the new node's peer
+        URLs before it starts. Returns the new member map."""
+        raw = await self._call("member_add", pb.MemberAddRequest(
+            peerURLs=list(peer_urls), isLearner=bool(is_learner)))
+        return {"id": int(raw.member.ID), "name": raw.member.name,
+                "peer-urls": list(raw.member.peerURLs)}
 
     async def remove_member(self, name: str) -> None:
         for m in await self.member_list():
             if m["name"] == name:
-                await self._call("member_remove",
-                                 pb.MemberRemoveRequest(ID=m["id"]))
+                await self.remove_member_by_id(m["id"])
                 return
         raise SimError("member-not-found", name)
+
+    async def remove_member_by_id(self, member_id: int) -> None:
+        await self._call("member_remove",
+                         pb.MemberRemoveRequest(ID=int(member_id)))
 
     async def status(self) -> dict:
         raw = await self._call("status", pb.StatusRequest())

@@ -347,6 +347,17 @@ class HttpEtcdClient(Client):
                                              revision=rev))
                         if evs and not stop["flag"]:
                             loop.call_soon_threadsafe(on_events, evs)
+                    # stream EOF with neither a cancel frame nor a
+                    # local cancel: the server went away mid-stream
+                    # (killed node). Surface it as an indefinite outage
+                    # so the consumer re-establishes the watch instead
+                    # of waiting on a dead stream forever (same fix as
+                    # the native-gRPC reader)
+                    if not stop["flag"]:
+                        loop.call_soon_threadsafe(on_error, SimError(
+                            "unavailable",
+                            "watch stream ended without cancel (server "
+                            "went away)", definite=False))
             except BaseException as e:
                 if not stop["flag"]:
                     loop.call_soon_threadsafe(
@@ -379,16 +390,32 @@ class HttpEtcdClient(Client):
 
     async def add_member(self, name: str) -> None:
         raise SimError("unavailable",
-                       "member add needs peer URLs: use the control "
-                       "plane for real clusters", definite=True)
+                       "member add needs peer URLs: use "
+                       "member_add_urls (the local control plane, "
+                       "db/local.py, supplies them)", definite=True)
+
+    async def member_add_urls(self, peer_urls: list[str],
+                              is_learner: bool = False) -> dict:
+        """Real member add (MemberAdd, client.clj:615-622 analog): the
+        caller — the local control plane — knows the new node's peer
+        URLs before it starts. Returns the new member map."""
+        raw = await self._post("/v3/cluster/member/add",
+                               {"peerURLs": list(peer_urls),
+                                "isLearner": bool(is_learner)})
+        m = raw.get("member", {})
+        return {"id": int(m.get("ID", 0)), "name": m.get("name", ""),
+                "peer-urls": list(m.get("peerURLs", ()))}
 
     async def remove_member(self, name: str) -> None:
         for m in await self.member_list():
             if m["name"] == name:
-                await self._post("/v3/cluster/member/remove",
-                                 {"ID": m["id"]})
+                await self.remove_member_by_id(m["id"])
                 return
         raise SimError("member-not-found", name)
+
+    async def remove_member_by_id(self, member_id: int) -> None:
+        await self._post("/v3/cluster/member/remove",
+                         {"ID": int(member_id)})
 
     async def status(self) -> dict:
         raw = await self._post("/v3/maintenance/status", {})

@@ -36,6 +36,12 @@ def default_opts() -> dict:
         "serializable": False,
         "lazyfs": False,
         "client_type": "direct",        # or "etcdctl" (etcd.clj:161-164)
+        "db_mode": None,                # sim | live | local (None: infer
+                                        # from client_type)
+        "etcd_binary": None,            # --db local: argv prefix; None =
+                                        # etcd from PATH, else fake stub
+        "etcd_data_dir": None,          # --db local: data/log root
+        "etcd_env": None,               # --db local: extra child env
         "snapshot_count": 100,          # etcd.clj:197-200
         "unsafe_no_fsync": False,       # etcd.clj:204 (opt-in, like etcd)
         "corrupt_check": False,         # etcd.clj:164
@@ -44,6 +50,54 @@ def default_opts() -> dict:
         "version": "sim-3.5.6",         # etcd.clj:206-207 (pinned: the sim
                                         # has exactly one "binary")
     }
+
+
+#: faults the local control plane (db/local.py) can inject with plain
+#: process-level privileges
+LOCAL_FAULTS = {"kill", "pause", "member", "admin"}
+
+#: fault -> why `--db local` refuses it (each failure mode is specific
+#: and documented, not a blanket live-mode error; see README "Fault /
+#: privilege matrix")
+LOCAL_FAULT_REFUSALS = {
+    "partition": ("network partitions need a privileged netns/iptables "
+                  "layer (the reference isolates nodes with iptables "
+                  "over SSH); the process-level local control plane "
+                  "cannot reshape loopback traffic"),
+    "clock": ("clock skew needs per-process time virtualization "
+              "(CAP_SYS_TIME / libfaketime); the local control plane "
+              "does not alter the host clock"),
+    "bitflip-wal": ("on-disk corruption injection targets the "
+                    "simulated WAL/snapshot files; a real etcd's data "
+                    "dir has no byte-level corruption hook here"),
+}
+LOCAL_FAULT_REFUSALS["bitflip-snap"] = LOCAL_FAULT_REFUSALS["bitflip-wal"]
+LOCAL_FAULT_REFUSALS["truncate-wal"] = LOCAL_FAULT_REFUSALS["bitflip-wal"]
+
+
+def _check_fault_support(db_mode: str, o: dict) -> None:
+    """Refuse unsupportable fault requests up front, specifically."""
+    faults = list(o.get("nemesis") or [])
+    if not faults:
+        return
+    if db_mode == "live":
+        # the reference faults real nodes over SSH (db.clj); an
+        # external cluster offers only the client wire
+        raise ValueError(
+            f"live mode (--client-type {o['client_type']}) has no "
+            f"control plane for faults {faults}: the cluster is "
+            "external. Use --db local to spawn and fault local etcd "
+            "processes, or the simulated cluster")
+    if db_mode == "local":
+        refused = [f for f in faults if f not in LOCAL_FAULTS]
+        if refused:
+            reasons = "; ".join(
+                f"{f}: {LOCAL_FAULT_REFUSALS.get(f, 'not implemented')}"
+                for f in sorted(set(refused)))
+            raise ValueError(
+                f"--db local cannot inject {sorted(set(refused))} — "
+                f"{reasons}. Supported local faults: "
+                f"{sorted(LOCAL_FAULTS)}")
 
 
 def etcd_test(opts: dict) -> dict:
@@ -56,14 +110,23 @@ def etcd_test(opts: dict) -> dict:
     wl_fn = workloads()[o["workload"]]
     workload = wl_fn(o)
     live = o["client_type"] in ("http", "grpc")
-    if live and o["nemesis"]:
-        # the reference faults real nodes over SSH (db.clj); live mode
-        # has only the client wire, so faults stay a sim capability
+    db_mode = o.get("db_mode") or ("live" if live else "sim")
+    o["db_mode"] = db_mode
+    if db_mode in ("live", "local") and not live:
         raise ValueError(
-            f"live mode (--client-type {o['client_type']}) has no "
-            f"control plane for faults {o['nemesis']}; drop --nemesis "
-            "or use the simulated cluster")
-    if live:
+            f"--db {db_mode} drives real etcd over the live wire; use "
+            "--client-type http or grpc (direct/etcdctl speak to the "
+            "simulated cluster only)")
+    if db_mode == "sim" and live:
+        raise ValueError(
+            f"--client-type {o['client_type']} speaks to real etcd; "
+            "--db sim has no live endpoints. Use --db live (external "
+            "cluster) or --db local (locally spawned processes)")
+    _check_fault_support(db_mode, o)
+    if db_mode == "local":
+        from .db.local import local_db
+        o["db"] = local_db(o)
+    elif db_mode == "live":
         from .db.live import live_db
         o["db"] = live_db(o)
     else:

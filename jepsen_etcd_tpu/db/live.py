@@ -62,8 +62,9 @@ class LiveDb:
     def _unsupported(self, what: str) -> str:
         raise SimError("unsupported",
                        f"live mode cannot {what}: no control plane on an "
-                       f"external cluster (use the simulated cluster for "
-                       f"fault testing)", definite=True)
+                       f"external cluster (use --db local to spawn and "
+                       f"fault local etcd processes, or the simulated "
+                       f"cluster)", definite=True)
 
     def start(self, test: dict, node: str) -> str:
         return self._unsupported("start nodes")

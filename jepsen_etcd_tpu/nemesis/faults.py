@@ -20,7 +20,6 @@ from __future__ import annotations
 from typing import Any, Optional
 
 from ..core.op import Op
-from ..client import DirectClient
 from ..generators import (fn_gen, limit, mix, stagger, delay, time_limit,
                           phases, any_gen, seq)
 from ..runner.sim import current_loop, sleep, SECOND
@@ -68,6 +67,13 @@ class _FnNemesis(Nemesis):
 class ComposedNemesis(Nemesis):
     def __init__(self, parts: list[Nemesis]):
         self.parts = parts
+
+    @property
+    def fs(self) -> set:
+        out: set = set()
+        for p in self.parts:
+            out |= p.fs
+        return out
 
     async def setup(self, test: dict) -> None:
         for p in self.parts:
@@ -363,13 +369,25 @@ def corrupt_package(opts: dict, faults: set) -> Optional[dict]:
 
 # ---- admin (compact / defrag) ---------------------------------------------
 
+def _admin_nodes(test: dict) -> list[str]:
+    """Current cluster membership for admin targeting: the db's member
+    set tracks grow/shrink; test['nodes'] is only the starting roster."""
+    db = test.get("db")
+    members = getattr(db, "members", None) if db is not None else None
+    return sorted(members or test["nodes"])
+
+
 def admin_package(opts: dict) -> dict:
     interval = int(opts.get("nemesis_interval", 5) * SECOND)
+    # the client factory dispatches on client_type/db_mode, so admin
+    # ops work identically against the simulated cluster and the local
+    # control plane's real processes
+    from ..client import client as make_client
 
     async def compact(test, op):
         rng = current_loop().rng
-        node = rng.choice(sorted(test["cluster"].nodes))
-        c = DirectClient(test["cluster"], node)
+        node = rng.choice(_admin_nodes(test))
+        c = make_client(test, node)
         try:
             rev = await c.revision()
             await c.compact(rev, physical=True)
@@ -377,23 +395,27 @@ def admin_package(opts: dict) -> dict:
         except (SimError, TimeoutError) as e:
             return op.evolve(type="info", value="compact-failed",
                              error=str(e))
+        finally:
+            c.close()
 
     async def defrag(test, op):
         out = {}
-        for node in op.value or sorted(test["cluster"].nodes):
-            c = DirectClient(test["cluster"], node)
+        for node in op.value or _admin_nodes(test):
+            c = make_client(test, node)
             try:
                 await c.defrag()
                 out[node] = "defragged"
             except (SimError, TimeoutError) as e:
                 out[node] = f"defrag-failed: {e}"
+            finally:
+                c.close()
         return op.evolve(type="info", value=out)
 
     def gen_compact(test, ctx):
         return {"f": "compact", "value": None}
 
     def gen_defrag(test, ctx):
-        nodes = sorted(test["cluster"].nodes)
+        nodes = _admin_nodes(test)
         if ctx.rng.random() < 0.5:
             nodes = ctx.rng.sample(nodes, ctx.rng.randint(1, len(nodes)))
         return {"f": "defrag", "value": sorted(nodes)}

@@ -168,10 +168,12 @@ def run_test(test: dict) -> dict:
 
 
 def _analyze_and_save(test: dict, history, store_dir: str, cluster,
-                      task_leak, sim_seconds: float, t0: float) -> dict:
+                      task_leak, sim_seconds: float, t0: float,
+                      node_logs: Optional[dict] = None) -> dict:
     """Shared run epilogue: checker pass, task-leak / corrupt-check
     result merge, artifact save, summary line. cluster is None for live
-    runs (no simulated nodes: no node logs, no fingerprints, no trace)."""
+    runs (no simulated nodes, no trace); node_logs overrides the
+    cluster-derived logs (the local control plane collects its own)."""
     logger.info("Analyzing %d ops (history in %s)", len(history), store_dir)
     results = test["checker"].check(test, history,
                                     {"store_dir": store_dir})
@@ -186,8 +188,10 @@ def _analyze_and_save(test: dict, history, store_dir: str, cluster,
         results["corrupt-check"] = {"valid?": not alarms, "alarms": alarms}
         if alarms:
             results["valid?"] = False
-    node_logs = {} if cluster is None else {
-        name: list(node.etcd_log) for name, node in cluster.nodes.items()}
+    if node_logs is None:
+        node_logs = {} if cluster is None else {
+            name: list(node.etcd_log)
+            for name, node in cluster.nodes.items()}
     save_run(store_dir, test, history, results, node_logs)
     if cluster is not None and cluster.tracer is not None:
         import os
@@ -202,13 +206,16 @@ def _analyze_and_save(test: dict, history, store_dir: str, cluster,
 
 
 def run_test_live(test: dict) -> dict:
-    """Run a composed test against a LIVE etcd over its JSON gateway
-    (the CLI-drives-a-real-cluster shape of etcd.clj:246-257).
+    """Run a composed test against REAL etcd processes (the
+    CLI-drives-a-real-cluster shape of etcd.clj:246-257).
 
     Same sequence as run_test, on a WallLoop (runner/wall.py): real
-    time, real I/O, no simulated cluster — test['nodes'] are endpoint
-    URLs, the DB layer is the readiness-barrier LiveDb, and faults are
-    rejected upstream (compose) since there is no control plane."""
+    time, real I/O, no simulated cluster. With --db live,
+    test['nodes'] are endpoint URLs of an external cluster and faults
+    are rejected upstream (compose): no control plane. With --db
+    local, nodes are names, the LocalDb control plane (db/local.py)
+    spawns and faults the processes, and the nemesis runs exactly as
+    in the sim path."""
     from .wall import WallLoop
     loop = WallLoop(seed=test.get("seed", 0))
     set_current_loop(loop)
@@ -220,19 +227,30 @@ def run_test_live(test: dict) -> dict:
     try:
         db = test["db"]
         pool = ClientPool(test)
+        nemesis_obj = test.get("nemesis")
 
         async def invoke(process: int, op: Op) -> Op:
             client = pool.client_for(process)
             return await client.invoke(test, op)
 
+        nemesis_invoke = None
+        if nemesis_obj is not None:
+            async def nemesis_invoke(op: Op) -> Op:
+                return await nemesis_obj.invoke(test, op)
+
         async def main() -> History:
             logger.info("Awaiting live cluster %s", test["nodes"])
             await db.setup(test)
+            if nemesis_obj is not None:
+                await nemesis_obj.setup(test)
             await pool.setup_initial(test["concurrency"])
             logger.info("Running generator (wall clock)")
             h = await interpret(test, test["generator"], invoke,
-                                test["concurrency"])
+                                test["concurrency"],
+                                nemesis_invoke=nemesis_invoke)
             await pool.teardown()
+            if nemesis_obj is not None:
+                await nemesis_obj.teardown(test)
             await db.teardown(test)
             # grace before the leak scan: same TIMEOUT-derived bound as
             # the sim path, so in-flight rpcs and keepalive pumps
@@ -254,5 +272,9 @@ def run_test_live(test: dict) -> dict:
         set_current_loop(None)
         loop.shutdown()
 
+    # local-mode node logs come from the control plane's per-node
+    # capture files (db.clj:234-242); plain live mode has no shell on
+    # the nodes, so its log_files() is empty
     return _analyze_and_save(test, history, store_dir, None,
-                             task_leak, sim_seconds, t0)
+                             task_leak, sim_seconds, t0,
+                             node_logs=db.log_files(test))

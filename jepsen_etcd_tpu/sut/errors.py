@@ -39,6 +39,10 @@ ERROR_TYPES: dict[str, bool] = {
     "corrupt": True,                     # corruption alarm / refuse to serve
     "task-leak": True,                   # sshj thread-leak analog,
                                          # support.clj:57-72
+    "crash-loop": True,                  # local node died repeatedly
+                                         # during startup (db/local.py)
+    "unsupported": True,                 # fault not available in this
+                                         # db mode (db/live, db/local)
 }
 
 

@@ -28,7 +28,7 @@ from typing import Iterator
 
 from .errors import SimError
 from .store import Txn
-from .http_gateway import GatewayState
+from .http_gateway import GatewayState, member_id_for_peer_urls
 from ..client.proto import etcd_rpc_pb2 as pb
 
 _CMP_OP = {pb.Compare.EQUAL: "=", pb.Compare.LESS: "<",
@@ -212,23 +212,68 @@ class _Services:
 
     # ---- cluster / maintenance --------------------------------------------
 
+    def _member_pb(self, mid: int) -> pb.Member:
+        m = self.st.members[mid]
+        return pb.Member(ID=mid, name=m.get("name", ""),
+                         peerURLs=list(m.get("peerURLs", ())),
+                         clientURLs=(list(m.get("clientURLs", ()))
+                                     or ["grpc://local"]))
+
     def member_list(self, req, ctx) -> pb.MemberListResponse:
-        return pb.MemberListResponse(members=[pb.Member(
-            ID=1, name="gw0", peerURLs=["http://localhost:0"],
-            clientURLs=["grpc://local"])])
+        with self.st.lock:
+            return pb.MemberListResponse(
+                members=[self._member_pb(mid)
+                         for mid in sorted(self.st.members)])
+
+    def member_add(self, req: pb.MemberAddRequest,
+                   ctx) -> pb.MemberAddResponse:
+        import grpc
+        peer_urls = list(req.peerURLs)
+        if not peer_urls:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                      "etcdserver: peerURL exists or is empty")
+        mid = member_id_for_peer_urls(peer_urls)
+        with self.st.lock:
+            if mid in self.st.members:
+                ctx.abort(grpc.StatusCode.ALREADY_EXISTS,
+                          "etcdserver: member ID already exist")
+            self.st.members[mid] = {"name": "", "peerURLs": peer_urls,
+                                    "clientURLs": []}
+            return pb.MemberAddResponse(
+                header=pb.ResponseHeader(
+                    revision=self.st.store.revision,
+                    member_id=self.st.member_id),
+                member=pb.Member(ID=mid, peerURLs=peer_urls),
+                members=[self._member_pb(m)
+                         for m in sorted(self.st.members)])
 
     def member_remove(self, req, ctx) -> pb.MemberRemoveResponse:
         import grpc
-        ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
-                  "etcdserver: re-configuration failed due to not "
-                  "enough started members")
+        mid = int(req.ID)
+        with self.st.lock:
+            if mid not in self.st.members:
+                ctx.abort(grpc.StatusCode.NOT_FOUND,
+                          "etcdserver: member not found")
+            if len(self.st.members) == 1:
+                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "etcdserver: re-configuration failed due to "
+                          "not enough started members")
+            del self.st.members[mid]
+            return pb.MemberRemoveResponse(
+                header=pb.ResponseHeader(
+                    revision=self.st.store.revision,
+                    member_id=self.st.member_id),
+                members=[self._member_pb(m)
+                         for m in sorted(self.st.members)])
 
     def status(self, req, ctx) -> pb.StatusResponse:
         with self.st.lock:
             rev = self.st.store.revision
+            leader = self.st.leader_id()
+            mid = self.st.member_id
         return pb.StatusResponse(
-            header=pb.ResponseHeader(revision=rev, member_id=1),
-            leader=1, raftTerm=2, raftIndex=rev,
+            header=pb.ResponseHeader(revision=rev, member_id=mid),
+            leader=leader, raftTerm=2, raftIndex=rev,
             version="3.5.6-sim-gateway", dbSize=0)
 
     def defragment(self, req, ctx) -> pb.DefragmentResponse:
@@ -287,14 +332,15 @@ class _Services:
             time.sleep(0.02)
 
 
-def serve_grpc(port: int = 0):
+def serve_grpc(port: int = 0, state: GatewayState = None):
     """Start the gRPC gateway on localhost:port (0 = ephemeral);
     returns (server, state, bound_port). Caller stop()s the server
-    when done."""
+    when done. Pass `state` to serve a pre-configured cluster
+    surface."""
     import grpc
     from concurrent import futures
 
-    state = GatewayState()
+    state = state if state is not None else GatewayState()
     svc = _Services(state)
 
     def unary(fn, req_cls):
@@ -325,6 +371,7 @@ def serve_grpc(port: int = 0):
         }),
         grpc.method_handlers_generic_handler("etcdserverpb.Cluster", {
             "MemberList": unary(svc.member_list, pb.MemberListRequest),
+            "MemberAdd": unary(svc.member_add, pb.MemberAddRequest),
             "MemberRemove": unary(svc.member_remove,
                                   pb.MemberRemoveRequest),
         }),

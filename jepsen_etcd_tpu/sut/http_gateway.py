@@ -21,6 +21,7 @@ import base64
 import json
 import threading
 import time
+import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
@@ -44,6 +45,15 @@ def _unval(s: str) -> Any:
         return raw.decode("utf-8", "replace")
 
 
+def member_id_for_peer_urls(peer_urls) -> int:
+    """Stable member id from peer URLs (stand-in for etcd's
+    hash-of-peer-URLs+cluster-name id derivation): the same member gets
+    the same id whether it is computed by a gateway handling MemberAdd
+    or by the fake binary parsing --initial-cluster."""
+    blob = ",".join(sorted(peer_urls)).encode("utf-8")
+    return zlib.crc32(blob) or 1  # 0 is "no leader" on the wire
+
+
 _TARGET_FIELD = {"VALUE": ("value", "value"),
                  "VERSION": ("version", "version"),
                  "MOD": ("mod_revision", "mod_revision"),
@@ -52,11 +62,34 @@ _RESULT_OP = {"EQUAL": "=", "LESS": "<", "GREATER": ">"}
 
 
 class GatewayState:
-    def __init__(self):
+    def __init__(self, name: str = "gw0", member_id: int = 1,
+                 members: Optional[dict[int, dict]] = None):
         self.store = Store()
         self.lock = threading.Lock()
         self.leases: dict[int, int] = {}  # id -> ttl seconds
         self.next_lease = 0x1000
+        # cluster surface: which member this gateway claims to be, and
+        # its view of the membership ({id: {"name", "peerURLs",
+        # "clientURLs"}}). Defaults preserve the original single-member
+        # gateway; the fake-etcd harness passes the full roster so the
+        # member list / add / remove API behaves like a real node's.
+        self.name = name
+        self.member_id = member_id
+        self.members: dict[int, dict] = members if members is not None else {
+            member_id: {"name": name,
+                        "peerURLs": ["http://localhost:0"],
+                        "clientURLs": []}}
+
+    def leader_id(self) -> int:
+        # deterministic single leader across every node's view: the
+        # lowest member id (fake nodes share no raft; min() agrees)
+        return min(self.members) if self.members else 0
+
+    def member_wire(self, mid: int) -> dict:
+        m = self.members[mid]
+        return {"ID": str(mid), "name": m.get("name", ""),
+                "peerURLs": list(m.get("peerURLs", ())),
+                "clientURLs": list(m.get("clientURLs", ()))}
 
     def kv_wire(self, kv: dict) -> dict:
         return {
@@ -151,17 +184,31 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/v3/lock/unlock":
                 return self._unlock(body)
             if path == "/v3/cluster/member/list":
-                return self._json({"members": [{
-                    "ID": "1", "name": "gw0",
-                    "peerURLs": ["http://localhost:0"],
-                    "clientURLs": [f"http://{self.headers.get('Host')}"],
-                }]})
+                with st.lock:
+                    members = [st.member_wire(mid)
+                               for mid in sorted(st.members)]
+                # a default single-member gateway advertises its own
+                # address (original behaviour); rosters injected by the
+                # harness carry real client URLs already
+                for m in members:
+                    if not m["clientURLs"]:
+                        m["clientURLs"] = [
+                            f"http://{self.headers.get('Host')}"]
+                return self._json({"members": members})
+            if path == "/v3/cluster/member/add":
+                return self._member_add(body)
+            if path == "/v3/cluster/member/remove":
+                return self._member_remove(body)
             if path == "/v3/maintenance/status":
                 with st.lock:
                     rev = st.store.revision
+                    leader = st.leader_id()
+                    mid = st.member_id
                 return self._json({
-                    "header": {"revision": str(rev), "member_id": "1"},
-                    "leader": "1", "raftTerm": "2", "raftIndex": str(rev),
+                    "header": {"revision": str(rev),
+                               "member_id": str(mid)},
+                    "leader": str(leader), "raftTerm": "2",
+                    "raftIndex": str(rev),
                     "version": "3.5.6-sim-gateway", "dbSize": "0"})
             if path == "/v3/maintenance/defragment":
                 return self._json({"header": {}})
@@ -271,6 +318,54 @@ class _Handler(BaseHTTPRequestHandler):
             st.store.apply_txn(Txn((), (("delete", key),), ()))
         self._json({"header": {}})
 
+    # -- cluster membership ---------------------------------------------------
+
+    def _member_add(self, body: dict) -> None:
+        st = self.state
+        peer_urls = list(body.get("peerURLs") or ())
+        if not peer_urls:
+            return self._error(400, 3,
+                               "etcdserver: peerURL exists or is empty")
+        # same derivation as the fake binary (crc32 of sorted peer
+        # URLs), so an added member keeps its id once it starts and
+        # reports itself via --initial-cluster
+        mid = member_id_for_peer_urls(peer_urls)
+        with st.lock:
+            if mid in st.members:
+                return self._error(
+                    400, 6, "etcdserver: member ID already exist")
+            # like real etcd: an added-but-unstarted member has no name
+            st.members[mid] = {"name": "", "peerURLs": peer_urls,
+                               "clientURLs": []}
+            members = [st.member_wire(m) for m in sorted(st.members)]
+            rev = st.store.revision
+        return self._json({
+            "header": {"revision": str(rev),
+                       "member_id": str(st.member_id)},
+            "member": {"ID": str(mid), "name": "",
+                       "peerURLs": peer_urls, "clientURLs": []},
+            "members": members})
+
+    def _member_remove(self, body: dict) -> None:
+        st = self.state
+        mid = int(body["ID"])
+        with st.lock:
+            if mid not in st.members:
+                return self._error(
+                    400, 5, "etcdserver: member not found")
+            if len(st.members) == 1:
+                return self._error(
+                    400, 9,
+                    "etcdserver: re-configuration failed due to not "
+                    "enough started members")
+            del st.members[mid]
+            members = [st.member_wire(m) for m in sorted(st.members)]
+            rev = st.store.revision
+        return self._json({
+            "header": {"revision": str(rev),
+                       "member_id": str(st.member_id)},
+            "members": members})
+
     # -- watch (chunked stream) ----------------------------------------------
 
     def _watch(self, body: dict) -> None:
@@ -339,11 +434,14 @@ class _Handler(BaseHTTPRequestHandler):
             pass
 
 
-def serve(port: int = 0) -> tuple[ThreadingHTTPServer, GatewayState]:
+def serve(port: int = 0,
+          state: Optional[GatewayState] = None,
+          ) -> tuple[ThreadingHTTPServer, GatewayState]:
     """Start the gateway on localhost:port (0 = ephemeral); returns
     (server, state). Caller runs server.serve_forever() in a thread and
-    shutdown()s it when done."""
-    state = GatewayState()
+    shutdown()s it when done. Pass `state` to serve a pre-configured
+    cluster surface (the fake-etcd harness injects its roster)."""
+    state = state if state is not None else GatewayState()
     handler = type("Handler", (_Handler,), {"state": state})
     srv = ThreadingHTTPServer(("127.0.0.1", port), handler)
     # watch handlers poll between events; never block server_close (or

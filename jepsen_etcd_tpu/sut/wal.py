@@ -258,6 +258,11 @@ class RecordFile:
 
     @property
     def size(self) -> int:
+        # Unserialized files report a SAMPLED estimate (EST_SAMPLE items
+        # extrapolated to the full count), not an exact byte size. Fine
+        # for perf plots and relative comparisons; do NOT gate threshold
+        # logic (quota, corruption windows) on it — serialize first if
+        # an exact size matters.
         if self._bytes is not None:
             return len(self._bytes)
         if not self._est_samples:

@@ -23,7 +23,30 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # newer jax spells the device-count override as a config option; on
+    # versions without it the XLA_FLAGS fallback above already forced 8
+    # host devices before backend init
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 # NOTE: no enable_compile_cache() here — it would initialize backends
 # (breaking the jax_num_cpu_devices update above) and is a no-op on the
 # cpu backend anyway
+
+import shutil  # noqa: E402
+
+import pytest  # noqa: E402
+
+#: real etcd binary, if one is on PATH (None in the hermetic CI image);
+#: @pytest.mark.live tests depend on the fixture below and skip cleanly
+ETCD_BINARY = shutil.which("etcd")
+
+
+@pytest.fixture(scope="session")
+def etcd_binary():
+    """Path to a real etcd binary; skips the test when absent."""
+    if ETCD_BINARY is None:
+        pytest.skip("real etcd binary not on PATH — install etcd to "
+                    "activate @pytest.mark.live tests")
+    return ETCD_BINARY
