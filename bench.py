@@ -106,7 +106,9 @@ def bench_register_10k():
     h = sim_register_history(N_OPS, CONCURRENCY, name="bench-register-10k")
     gen_s = time.time() - t0
     note(f"10k: generated {len(h)} ops in {gen_s:.1f}s")
+    t1 = time.time()
     p = wgl.pack_register_history(h)
+    pack_s = time.time() - t1
     assert p.ok, p.reason
     wgl.check_packed(p)  # warmup: compile + first search
     # best of 3: a synchronized tunnel round trip carries tens of ms
@@ -137,7 +139,7 @@ def bench_register_10k():
          f"w={p.w} in {dt:.3f}s (prep {prep_ms:.0f}ms, device-resident "
          f"{best*1e3:.0f}ms)")
     assert out["valid?"] is True, out
-    return dt, out, p, gen_s, prep_ms, best * 1e3
+    return dt, out, p, gen_s, prep_ms, best * 1e3, pack_s
 
 
 def bench_register_100():
@@ -291,7 +293,9 @@ def bench_deep_wgl():
     t0 = time.time()
     h = sim_register_history(2600, 20, seed=5, name="bench-register-deep")
     gen_s = time.time() - t0
+    t0 = time.time()
     p = wgl.pack_register_history(h)
+    pack_s = time.time() - t0
     assert p.ok, p.reason
 
     t0 = time.time()
@@ -335,6 +339,7 @@ def bench_deep_wgl():
     return {"value": round(prod_s, 4), "unit": "s",
             "gen_s": round(gen_s, 2),
             "ops": p.R, "w": p.w,
+            "pack_s": round(pack_s, 4),
             "native_s": round(native_s, 4),
             "ladder_s": round(ladder_s, 4),
             "production_s": round(prod_s, 4),
@@ -356,7 +361,10 @@ def bench_batched_keys():
     K = 64
     subs, gen_s, total_ops = gen_batched_keys(K, 8, 200, seed=3)
     note(f"batched {K}: generated {total_ops} ops in {gen_s:.1f}s")
-    packs = [wgl.pack_register_history(subs[k]) for k in range(K)]
+    t0 = time.time()
+    packs_by_key = wgl.pack_register_histories_batched(subs)
+    pack_s = time.time() - t0
+    packs = [packs_by_key[k] for k in range(K)]
     wgl_mxu.check_packed_batch_mxu(packs)  # warmup compiles
     t0 = time.time()
     results = wgl_mxu.check_packed_batch_mxu(packs)
@@ -377,6 +385,8 @@ def bench_batched_keys():
     note(f"batched {K} (production): engines={engines} in {prod_s:.3f}s")
     return {"value": round(prod_s, 4), "unit": "s",
             "gen_s": round(gen_s, 2), "keys": K,
+            "pack_s": round(pack_s, 4),
+            "pack_ms_per_key": round(1e3 * pack_s / K, 3),
             "kernel_s": round(kernel_s, 4),
             "production_s": round(prod_s, 4), "engines": engines,
             "keys_per_s": round(K / max(prod_s, 1e-9), 1),
@@ -392,7 +402,9 @@ def bench_register_50k():
                              nodes=["n1", "n2", "n3"])
     gen_s = time.time() - t0
     note(f"50k: generated {len(h)} ops in {gen_s:.1f}s")
+    t0 = time.time()
     p = wgl.pack_register_history(h)
+    pack_s = time.time() - t0
     assert p.ok, p.reason
     wgl.check_packed(p)  # warmup: compile + first search
     t1 = time.time()
@@ -403,6 +415,7 @@ def bench_register_50k():
          f"w={p.w} in {dt:.3f}s")
     assert out["valid?"] is True, out
     return {"value": round(dt, 4), "unit": "s", "gen_s": round(gen_s, 2),
+            "pack_s": round(pack_s, 4),
             "ops": p.R, "w": p.w, "waves": out.get("waves"),
             "engine": out.get("engine"),
             "peak_frontier": out.get("peak-frontier"),
@@ -414,18 +427,24 @@ def bench_batched_512_keys():
     windows for most keys, exercising the two-word kernel). kernel_s =
     one MXU dispatch per (bucket, width) group — r5 cut it ~4x (one-hot
     matmul table gather, matmul wave reductions, 8 KB readback), under
-    the 0.45 s r4-production bar. The router still keeps the native
-    sweep in production here BY MEASUREMENT: r5 also sped the shared
-    host path up (~1.4x), and at 200-entry keys the per-key Python
-    packing floor alone exceeds the native DFS's entire per-key budget
-    (BATCH_DFS_MAX's measured table in checkers/tpu_linearizable.py)."""
+    the 0.45 s r4-production bar. pack_s is the batched SoA packer
+    (ops/wgl.py pack_register_histories_batched): ONE numpy pass over
+    all K subhistories instead of a per-key Python loop — the r5
+    per-key packing floor it replaced was large enough to decide
+    routing by itself (the deleted BATCH_DFS_MAX); routing now keys on
+    the measured engine times with packing reported separately."""
     from jepsen_etcd_tpu.ops import wgl, wgl_mxu
     from jepsen_etcd_tpu.checkers.tpu_linearizable import (
         TPULinearizableChecker)
     K = 512
     subs, gen_s, total_ops = gen_batched_keys(K, 16, 100, seed=29)
     note(f"512-key: generated {total_ops} ops in {gen_s:.1f}s")
-    packs = [wgl.pack_register_history(subs[k]) for k in range(K)]
+    t1 = time.time()
+    packs_by_key = wgl.pack_register_histories_batched(subs)
+    pack_s = time.time() - t1
+    packs = [packs_by_key[k] for k in range(K)]
+    note(f"512-key: packed in {pack_s:.3f}s "
+         f"({1e3 * pack_s / K:.2f} ms/key)")
     widths = {}
     for p in packs:
         widths[p.w] = widths.get(p.w, 0) + 1
@@ -444,6 +463,8 @@ def bench_batched_512_keys():
          f"widths={widths} ({K/max(prod_s,1e-9):.0f} keys/s)")
     return {"value": round(prod_s, 4), "unit": "s",
             "gen_s": round(gen_s, 2), "keys": K, "widths": widths,
+            "pack_s": round(pack_s, 4),
+            "pack_ms_per_key": round(1e3 * pack_s / K, 3),
             "kernel_s": round(kernel_s, 4),
             "production_s": round(prod_s, 4),
             "keys_per_s": round(K / max(prod_s, 1e-9), 1),
@@ -465,7 +486,9 @@ def bench_w128_deep():
     t0 = time.time()
     h = sim_register_history(13000, 40, seed=13, name="bench-w128-deep")
     gen_s = time.time() - t0
+    t0 = time.time()
     p = wgl.pack_register_history(h)
+    pack_s = time.time() - t0
     assert p.ok and p.w == 128, (p.reason, p.w)
     wgl_mxu.check_packed_mxu(p)  # warmup compile
     t0 = time.time()
@@ -487,6 +510,7 @@ def bench_w128_deep():
          f"entries={len(h)} R={p.R}")
     return {"value": round(prod_s, 4), "unit": "s",
             "gen_s": round(gen_s, 2), "ops": p.R, "w": p.w,
+            "pack_s": round(pack_s, 4),
             "mxu_s": round(mxu_s, 4), "native_s": round(native_s, 4),
             "production_s": round(prod_s, 4),
             "production_engine": pr.get("engine"),
@@ -578,37 +602,70 @@ def bench_elle_append():
 
 
 def bench_closure_scale():
-    """VERDICT r3 #5: a closure size where the MXU path decisively
-    beats numpy. Six 2048-node subgraphs (the append checker's shape
-    at ~30 min of workload), measured host vs device."""
+    """VERDICT r3 #5 / ROADMAP #5: a closure size where the MXU path
+    decisively beats numpy. Six 2048-node subgraphs (the append
+    checker's shape at ~30 min of workload), measured host vs device —
+    the device leg DECOMPOSED into {transfer_s, compute_s}: the old
+    single number folded an O(B*N^2)-byte host->device copy plus the
+    O(B*N^2) reach readback into "kernel time". TFLOPS is computed from
+    the squarings the fixpoint early-exit actually executes (the
+    batched while_loop in ops/closure.py runs until NO plane grows,
+    i.e. max over planes of the per-plane fixpoint count), not the
+    worst-case ceil(log2 N) bound."""
     import numpy as np
     import jax
-    import jax.numpy as jnp
     from jepsen_etcd_tpu.ops import closure
     rng = np.random.RandomState(0)
     B, N = 6, 2048
     a = rng.rand(B, N, N) < (2.0 / N)
     iters = int(np.ceil(np.log2(N))) + 1
+    plane_sq = []   # per-plane squarings to fixpoint
     t0 = time.time()
     for b in range(B):
         r = a[b] | np.eye(N, dtype=bool)
+        prev, sq = int(r.sum()), 0
         for _ in range(iters):
             r = (r.astype(np.float32) @ r.astype(np.float32)) > 0
+            sq += 1
+            cur = int(r.sum())
+            if cur == prev:
+                break
+            prev = cur
+        plane_sq.append(sq)
     host_s = time.time() - t0
     f = closure._closure_device
-    np.asarray(f(jnp.asarray(a), iters)[0])  # warmup
-    best = 1e9
+    # transfer leg: the [B, N, N] bool stack over the host->device link
+    t0 = time.time()
+    a_dev = jax.block_until_ready(jax.device_put(a))
+    transfer_s = time.time() - t0
+    jax.block_until_ready(f(a_dev, iters))  # warmup: compile
+    compute_s = 1e9
     for _ in range(2):
         t0 = time.time()
-        np.asarray(f(jnp.asarray(a), iters)[0])
-        best = min(best, time.time() - t0)
-    note(f"closure scale N={N}: host={host_s:.2f}s device={best:.2f}s "
-         f"({host_s/max(best,1e-9):.1f}x)")
-    return {"value": round(best, 4), "unit": "s", "nodes": N,
+        jax.block_until_ready(f(a_dev, iters))
+        compute_s = min(compute_s, time.time() - t0)
+    dev_s = transfer_s + compute_s
+    # device executes max(plane_sq) squarings for ALL B planes (one
+    # batched while_loop); 2*N^3 flops per N x N squaring
+    sq_dev = max(plane_sq)
+    tflops = (B * sq_dev * 2 * N ** 3) / max(compute_s, 1e-9) / 1e12
+    extra = {}
+    if jax.default_backend() == "tpu":
+        # v5e peak: 197 bf16 TFLOPS/chip
+        peak = 197.0 * len(jax.devices())
+        extra["mfu_pct"] = round(100 * tflops / peak, 1)
+    note(f"closure scale N={N}: host={host_s:.2f}s "
+         f"device={compute_s:.2f}s compute + {transfer_s:.2f}s transfer "
+         f"({host_s/max(dev_s,1e-9):.1f}x, {tflops:.1f} TFLOPS, "
+         f"{sq_dev}/{iters} squarings)")
+    return {"value": round(dev_s, 4), "unit": "s", "nodes": N,
             "subgraphs": B, "host_s": round(host_s, 4),
-            "device_s": round(best, 4),
-            "speedup_x": round(host_s / max(best, 1e-9), 1),
-            "vs_baseline": round(host_s / max(best, 1e-9), 1)}
+            "transfer_s": round(transfer_s, 4),
+            "compute_s": round(compute_s, 4),
+            "squarings_run": sq_dev, "squarings_bound": iters,
+            "tflops": round(tflops, 2), **extra,
+            "speedup_x": round(host_s / max(dev_s, 1e-9), 1),
+            "vs_baseline": round(host_s / max(dev_s, 1e-9), 1)}
 
 
 def bench_watch():
@@ -629,34 +686,206 @@ def bench_watch():
             "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
 
 
+CELLS = [("register_100", bench_register_100),
+         ("engine_crossover", bench_engine_crossover),
+         ("deep_wgl_4n_2000", bench_deep_wgl),
+         ("w128_deep", bench_w128_deep),
+         ("faulted_register", bench_faulted_register),
+         ("batched_64_keys", bench_batched_keys),
+         ("register_50k", bench_register_50k),
+         ("batched_512_keys", bench_batched_512_keys),
+         ("set_full", bench_set),
+         ("elle_append_device", bench_elle_append),
+         ("closure_scale_2048", bench_closure_scale),
+         ("watch_edit_distance", bench_watch)]
+
+
+# ---------------------------------------------------------------------
+# --dry smoke mode: each check exercises the SAME code path as its
+# bench cell at tiny sizes and asserts STRUCTURE — engine routing and
+# packer equivalence — never timings, so it runs under tier-1 pytest
+# with JAX_PLATFORMS=cpu in seconds.
+# ---------------------------------------------------------------------
+
+_DRY_SEED = 99
+
+
+def _assert_packs_equal(a, b):
+    import dataclasses
+    import numpy as np
+    from jepsen_etcd_tpu.ops import wgl
+    wgl.ensure_frames(a)
+    wgl.ensure_frames(b)
+    for fld in dataclasses.fields(type(a)):
+        x, y = getattr(a, fld.name), getattr(b, fld.name)
+        if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+            assert np.array_equal(x, y), fld.name
+        else:
+            assert x == y, (fld.name, x, y)
+
+
+def _dry_register():
+    """Tiny single key: batched packer == per-key reference,
+    production routes below CPU_CUTOFF to the host engine, verdict
+    True."""
+    from jepsen_etcd_tpu.ops import wgl
+    from jepsen_etcd_tpu.checkers.tpu_linearizable import (
+        TPULinearizableChecker)
+    h = sim_register_history(40, 4, seed=_DRY_SEED, name="dry-register")
+    p = wgl.pack_register_history(h)
+    assert p.ok, p.reason
+    _assert_packs_equal(p, wgl._pack_reference(h))
+    res = TPULinearizableChecker().check({}, h)
+    assert res["valid?"] is True, res
+    assert res["checker"] == "cpu-oracle", res   # size-cutoff routing
+    return {"ops": p.R, "engine": res["checker"]}
+
+
+def _dry_batched():
+    """Tiny key batch: batched SoA packer bit-identical to the
+    reference per key, pack_perop_batch bit-identical to the per-key
+    loop, forced MXU batch verdicts agree with production routing."""
+    import numpy as np
+    from jepsen_etcd_tpu.ops import wgl, wgl_mxu
+    from jepsen_etcd_tpu.checkers.tpu_linearizable import (
+        TPULinearizableChecker)
+    K = 8
+    subs, _, _ = _sim_keys(range(K), 30, 4, _DRY_SEED, "dry-batched",
+                           nodes=["n1", "n2", "n3"])
+    packs_by_key = wgl.pack_register_histories_batched(subs)
+    for k in range(K):
+        _assert_packs_equal(packs_by_key[k],
+                            wgl._pack_reference(subs[k]))
+    packs = [packs_by_key[k] for k in range(K)]
+    sup = [p for p in packs if wgl_mxu.supported(p)]
+    assert sup, "no MXU-supported pack in the dry batch"
+    r_pad = max(max(wgl_mxu.bucket(p.R) for p in sup), wgl_mxu.TSUB)
+    bi, bu = wgl_mxu.pack_perop_batch(sup, r_pad, len(sup) + 2)
+    for j, p in enumerate(sup):
+        a, b = wgl_mxu.pack_perop(p, r_pad)
+        assert np.array_equal(bi[j], a) and np.array_equal(bu[j], b), j
+    mxu = wgl_mxu.check_packed_batch_mxu(packs)
+    pres = TPULinearizableChecker().check_batch({}, subs)
+    for i, k in enumerate(range(K)):
+        assert pres[k]["valid?"] is True, pres[k]
+        if mxu[i] is not None:
+            assert mxu[i]["valid?"] == pres[k]["valid?"], (k, mxu[i])
+            assert mxu[i]["engine"] == "mxu-wave", mxu[i]
+    engines = {r["checker"] for r in pres.values()}
+    assert engines == {"cpu-oracle"}, engines   # tiny keys: host route
+    return {"keys": K, "mxu_supported": len(sup),
+            "engines": sorted(engines)}
+
+
+def _dry_set():
+    """Tiny set workload: columnar analysis == reference sweep,
+    checker verdict True."""
+    import importlib
+    # the set_full() factory shadows the module name on package import
+    sf = importlib.import_module("jepsen_etcd_tpu.checkers.set_full")
+    test, out, _ = run_workload("set", time_limit=3, rate=100)
+    h = out["history"]
+    hh = h if isinstance(h, sf.History) else sf.History(h)
+    col = sf._analyze_columnar(hh)
+    ref = sf._analyze_reference(hh)
+    assert col == ref, "columnar set analysis diverges from reference"
+    res = sf.SetFull(linearizable=True).check(test, h)
+    assert res["valid?"] is True, res
+    return {"ops": len(h), "attempts": res["attempt-count"]}
+
+
+def _dry_closure():
+    """Tiny closure: fixpoint-early-exit device kernel bit-identical
+    to the numpy reference, cycle polarity both ways."""
+    import numpy as np
+    import jax.numpy as jnp
+    from jepsen_etcd_tpu.ops import closure
+    rng = np.random.RandomState(_DRY_SEED)
+    a = rng.rand(3, 48, 48) < 0.04
+    r_np, oc_np = closure._closure_numpy(a)
+    r_dev, oc_dev = closure._closure_device(jnp.asarray(a), 7)
+    assert np.array_equal(r_np, np.asarray(r_dev))
+    assert np.array_equal(oc_np, np.asarray(oc_dev))
+    acyc = np.triu(np.ones((2, 16, 16), bool), 1)  # DAG: no cycles
+    _, oc = closure._closure_device(jnp.asarray(acyc), 5)
+    assert not np.asarray(oc).any()
+    return {"subgraphs": 3, "nodes": 48,
+            "cycles": int(oc_np.any(axis=-1).sum())}
+
+
+def _dry_watch():
+    """Tiny watch workload through the real checker."""
+    from jepsen_etcd_tpu.checkers.watch import WatchChecker
+    test, out, _ = run_workload("watch", time_limit=3, rate=100)
+    res = WatchChecker(use_tpu=True).check(test, out["history"])
+    assert res["valid?"] in (True, "unknown"), res
+    return {"ops": len(out["history"]), "valid": res["valid?"]}
+
+
+DRY_CHECKS = {"register_100": _dry_register,
+              "engine_crossover": _dry_register,
+              "deep_wgl_4n_2000": _dry_register,
+              "w128_deep": _dry_register,
+              "faulted_register": _dry_register,
+              "register_50k": _dry_register,
+              "batched_64_keys": _dry_batched,
+              "batched_512_keys": _dry_batched,
+              "set_full": _dry_set,
+              "elle_append_device": _dry_closure,
+              "closure_scale_2048": _dry_closure,
+              "watch_edit_distance": _dry_watch,
+              "register_10k": _dry_register}
+
+
+def run_dry(cell: str | None) -> int:
+    names = [cell] if cell else sorted(set(DRY_CHECKS))
+    out = {}
+    for name in names:
+        fn = DRY_CHECKS[name]
+        t0 = time.time()
+        info = fn()
+        note(f"dry {name}: OK ({fn.__name__}, {time.time()-t0:.1f}s)")
+        out[name] = {"ok": True, "check": fn.__name__, **info}
+    print(json.dumps({"dry": out}))
+    return 0
+
+
 def main() -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cell", choices=[n for n, _ in CELLS]
+                    + ["register_10k"],
+                    help="run a single matrix cell")
+    ap.add_argument("--dry", action="store_true",
+                    help="smoke mode: tiny sizes, structural asserts "
+                         "(engine routing, packer equivalence), no "
+                         "timing asserts")
+    args = ap.parse_args()
     from jepsen_etcd_tpu.ops.common import enable_compile_cache
     enable_compile_cache()
+    if args.dry:
+        return run_dry(args.cell)
+    if args.cell and args.cell != "register_10k":
+        fn = dict(CELLS)[args.cell]
+        print(json.dumps({args.cell: fn()}))
+        return 0
     matrix = {}
-    for name, fn in [("register_100", bench_register_100),
-                     ("engine_crossover", bench_engine_crossover),
-                     ("deep_wgl_4n_2000", bench_deep_wgl),
-                     ("w128_deep", bench_w128_deep),
-                     ("faulted_register", bench_faulted_register),
-                     ("batched_64_keys", bench_batched_keys),
-                     ("register_50k", bench_register_50k),
-                     ("batched_512_keys", bench_batched_512_keys),
-                     ("set_full", bench_set),
-                     ("elle_append_device", bench_elle_append),
-                     ("closure_scale_2048", bench_closure_scale),
-                     ("watch_edit_distance", bench_watch)]:
-        try:
-            matrix[name] = fn()
-        except Exception as e:  # record, don't abort the headline bench
-            note(f"{name} FAILED: {e!r}")
-            matrix[name] = {"error": repr(e)}
+    if not args.cell:
+        for name, fn in CELLS:
+            try:
+                matrix[name] = fn()
+            except Exception as e:  # record, don't abort the headline
+                note(f"{name} FAILED: {e!r}")
+                matrix[name] = {"error": repr(e)}
 
-    check_s, out, p, gen_s, prep_ms, device_ms = bench_register_10k()
+    check_s, out, p, gen_s, prep_ms, device_ms, pack_s = \
+        bench_register_10k()
     print(json.dumps({
         "metric": "register_linearizability_10k_ops_check_wallclock",
         "value": round(check_s, 4),
         "unit": "s",
         "gen_s": round(gen_s, 2),
+        "host_pack_s": round(pack_s, 4),
         "host_prep_ms": round(prep_ms, 1),
         "device_ms": round(device_ms, 1),
         "engine": out.get("engine"),

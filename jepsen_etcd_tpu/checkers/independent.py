@@ -11,7 +11,7 @@ padded tensor and vmap over it (SURVEY §2.3).
 from __future__ import annotations
 
 from ..core.history import History
-from ..generators.independent import history_keys, subhistory
+from ..generators.independent import subhistories
 from .core import Checker, _merge_valid
 
 
@@ -21,7 +21,11 @@ class Independent(Checker):
 
     def check(self, test, history, opts=None) -> dict:
         h = history if isinstance(history, History) else History(history)
-        subs = {k: History(subhistory(h, k)) for k in history_keys(h)}
+        # one pass over the parent history builds every per-key
+        # subhistory (the per-key subhistory() loop re-scans the full
+        # history once per key — O(K * N) host time the batched packer
+        # axis can't afford)
+        subs = {k: History(ops) for k, ops in subhistories(h).items()}
         if hasattr(self.inner, "check_batch"):
             # batch-aware inner checker (TPULinearizableChecker): one
             # vmapped kernel launch over the whole key batch, sharded

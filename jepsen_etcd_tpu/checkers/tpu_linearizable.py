@@ -59,24 +59,16 @@ CPU_CUTOFF = 512
 #: over — exhaustion-priced, not predicted.
 DFS_FIRST_MAX = 13_000
 
-#: batched key-DP crossover: below this many entries PER KEY a batch
-#: of keys stays with the serial native sweep even though one fused
-#: dispatch would amortize the launch. MEASURED r5 (end-to-end incl.
-#: host packing, best-of-3, same machine states):
-#:
-#:   K    entries/key   native sweep   fused batch   ratio
-#:   512      200          0.34 s        0.87 s       0.39
-#:   256      400          0.33 s        0.97 s       0.34
-#:   256    1,000          1.64 s        1.64 s       1.00
-#:   64     2,000          1.34 s        1.32 s       1.01
-#:   64     4,000          2.64 s        2.68 s       0.99
-#:
-#: the limiting term below ~1k entries is HOST-side: per-key Python
-#: packing (~1.1 ms incl. history_entries) exceeds the native DFS's
-#: entire per-key budget (~0.7 ms), so no device speed can win the
-#: cell; at and past ~1k the two paths tie until the single-key
-#: quadratic blowup (DFS_FIRST_MAX) hands deep keys to the kernel.
-BATCH_DFS_MAX = 1_000
+#: (BATCH_DFS_MAX, r5's batched key-DP crossover at 1,000 entries/key,
+#: is deleted: its limiting term was HOST-side per-key Python packing
+#: — ~1.1 ms/key incl. history_entries, exceeding the native DFS's
+#: entire per-key budget of ~0.7 ms — which the batched SoA packer
+#: (wgl.pack_register_histories_batched) removed by vectorizing
+#: extraction + interning + window geometry across the whole key
+#: batch. With packing amortized, the batch band collapses to the
+#: same CPU_CUTOFF the single-key path uses: keys the native DFS
+#: answers in ms stay native, everything else amortizes one fused
+#: launch. Measured packing cost model in PERF.md §2.)
 
 
 class TPULinearizableChecker(Checker):
@@ -155,6 +147,21 @@ class TPULinearizableChecker(Checker):
             return wgl.pack_register_history
         if m == Mutex(False):
             return wgl.pack_mutex_history
+        return None
+
+    def _pack_batch_fn(self):
+        """Batched form of _pack_fn: one SoA packing pass over a whole
+        keyed dict of subhistories (wgl.pack_register_histories_batched)
+        instead of a per-key Python loop. None for CPU-only models."""
+        import functools
+        from ..ops import wgl
+        from ..models import Mutex
+        m = self.model_fn()
+        if m == VersionedRegister(0, None):
+            return wgl.pack_register_histories_batched
+        if m == Mutex(False):
+            return functools.partial(wgl.pack_register_histories_batched,
+                                     adapter=wgl.mutex_adapter)
         return None
 
     def _finalize(self, history, out: dict, pack=None,
@@ -297,17 +304,14 @@ class TPULinearizableChecker(Checker):
         # dispatch across those keys, so a per-key serial DFS over many
         # mid-size keys costs O(keys) against the launch's O(1) — but
         # for a handful the DFS's near-linear witness search wins.
-        # MEASURED r5 (native sweep vs fused batch end-to-end incl.
-        # packing, single v5e through axon, BATCH_DFS_MAX's comment):
-        # the batch crossover sits at ~1,000 entries/key — below it the
-        # in-process DFS wins outright (2.6x at 200-entry keys: the
-        # per-key Python packing floor exceeds the whole DFS search),
-        # at 1,000-6,000 the two tie, beyond the single-key table's
-        # crossover the kernel dominates.
+        # With the batched SoA packer the old ~1,000 entries/key batch
+        # crossover (BATCH_DFS_MAX, r5) is gone — its limiting term was
+        # the per-key Python packing floor, now amortized across the
+        # batch — so the band collapses to CPU_CUTOFF: any key past the
+        # single-key native-DFS cutoff joins the fused launch.
         mid_count = sum(1 for h in subhistories.values()
                         if len(h) > (self.cpu_cutoff or 0))
-        batch_band = None if mid_count <= 8 \
-            else max(self.cpu_cutoff or 0, BATCH_DFS_MAX)
+        batch_band = None if mid_count <= 8 else (self.cpu_cutoff or 0)
         for k in subhistories:
             band = self._small_history_check(subhistories[k],
                                              band=batch_band)
@@ -318,23 +322,27 @@ class TPULinearizableChecker(Checker):
                 bands[k] = band
         if not big_keys:
             return results
-        pack = self._pack_fn()
-        if pack is None:
+        pack_batch = self._pack_batch_fn()
+        if pack_batch is None:
             results.update({k: self.check(test, subhistories[k], opts,
                                           _band=bands[k])
                             for k in big_keys})
             return results
-        # pack everything, launch all fused (bucket, width) groups
-        # asynchronously, then collect with one synchronization — the
-        # only batching that pays on the measured cost model (each
-        # extra launch costs ~57 ms fixed, so fewer, larger dispatches
-        # always win over finer overlapped chunks through the tunnel).
-        # Launch and collect ride the shared _run_fused guard: the
-        # TPU-backend check, the JEPSEN_ETCD_TPU_NO_PALLAS_WGL kill
-        # switch, and degrade-don't-crash on Mosaic failures all apply
-        # to this production path exactly as inside check_packed_batch.
+        # pack ALL remaining keys in one batched SoA pass (vectorized
+        # across keys — the per-key Python packing floor that used to
+        # lose this cell to the native sweep is gone), launch all fused
+        # (bucket, width) groups asynchronously, then collect with one
+        # synchronization — the only batching that pays on the measured
+        # cost model (each extra launch costs ~57 ms fixed, so fewer,
+        # larger dispatches always win over finer overlapped chunks
+        # through the tunnel). Launch and collect ride the shared
+        # _run_fused guard: the TPU-backend check, the
+        # JEPSEN_ETCD_TPU_NO_PALLAS_WGL kill switch, and
+        # degrade-don't-crash on Mosaic failures all apply to this
+        # production path exactly as inside check_packed_batch.
         from ..ops import wgl_mxu
-        packs = [pack(subhistories[k]) for k in big_keys]
+        packed = pack_batch({k: subhistories[k] for k in big_keys})
+        packs = [packed[k] for k in big_keys]
         outs: list = [None] * len(big_keys)
         if self.f_max is None:
             launched = wgl._run_fused(

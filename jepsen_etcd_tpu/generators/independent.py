@@ -185,3 +185,21 @@ def subhistory(history, key) -> list:
         if isinstance(v, tuple) and len(v) == 2 and v[0] == key:
             out.append(op.evolve(value=v[1]))
     return out
+
+
+def subhistories(history) -> dict:
+    """All per-key subhistories in ONE pass over the parent history:
+    ``{key: ops}`` with keys in first-seen order, values unwrapped and
+    op indices preserved — equivalent to calling ``subhistory`` per key
+    of ``history_keys`` but O(N) instead of O(K * N), which is what the
+    batched checker axis needs (512 keys would otherwise re-scan the
+    full history 512 times before any checking starts)."""
+    out: dict = {}
+    for op in history:
+        v = op.get("value")
+        if isinstance(v, tuple) and len(v) == 2:
+            ops = out.get(v[0])
+            if ops is None:
+                ops = out[v[0]] = []
+            ops.append(op.evolve(value=v[1]))
+    return out

@@ -93,6 +93,29 @@ C_A1, C_A2, C_VER, C_FSK1, C_PRED, C_CEIL, C_LO, C_SHIFT, C_CEILB, \
     C_UF, C_R, C_SPARE = range(12)
 
 
+def _tpu_compiler_params(pltpu, dimension_semantics):
+    """jax renamed ``TPUCompilerParams`` -> ``CompilerParams`` (and back
+    again across 0.4.x/0.5.x); resolve whichever this jax ships."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(dimension_semantics=dimension_semantics)
+
+
+def _shard_map():
+    """``shard_map`` moved from jax.experimental to the jax namespace;
+    the keyword for replication checking renamed check_rep -> check_vma.
+    Returns (shard_map, vma_kwargs) for whichever API this jax ships."""
+    try:
+        from jax import shard_map as sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+    import inspect
+    params = inspect.signature(sm).parameters
+    if "check_vma" in params:
+        return sm, {"check_vma": False}
+    return sm, {"check_rep": False}
+
+
 def _dims(wk: int):
     """Derived layout constants for a window width."""
     nw = wk // 32            # mask words
@@ -200,6 +223,82 @@ def pack_perop(p: Packed, r_pad: int):
     u16[:R, C_CEILB] = relb
     u16[:R, C_UF] = uf
     u16[:, C_R] = R
+    return i32, u16
+
+
+def pack_perop_batch(packs: list, r_pad: int, k_pad: int | None = None):
+    """Vectorized ``pack_perop`` over a whole launch chunk: ONE numpy
+    pass over the concatenated per-op columns fills the [k_pad, r_pad,
+    4] int32 and [k_pad, r_pad, 12] uint16 batch tensors, bit-identical
+    to the per-key loop (differentially tested).
+
+    The per-key loop was the last O(K) host floor on the batched key-DP
+    axis: ~15 numpy dispatches per key at K=512 cost more in call
+    overhead than the actual byte traffic (every column is [R] with R
+    typically < 256). Concatenating first amortizes the dispatch over
+    the whole chunk, and a single fancy-index row scatter lands every
+    key at ``kid * r_pad + row`` in the padded batch tensor. Padding
+    keys beyond ``len(packs)`` stay all-zero (R = 0) rows, exactly as
+    the caller's preallocated tensors had them."""
+    K = len(packs)
+    kp = K if k_pad is None else k_pad
+    i32 = np.zeros((kp, r_pad, 4), dtype=np.int32)
+    u16 = np.zeros((kp, r_pad, 12), dtype=np.uint16)
+    if K == 0:
+        return i32, u16
+    Rs = np.fromiter((p.R for p in packs), dtype=np.int64, count=K)
+    # C_R rides every row (real and pad) of a real key
+    u16[:K, :, C_R] = Rs[:, None].astype(np.uint16)
+    N = int(Rs.sum())
+    if N == 0:
+        return i32, u16
+    kid = np.repeat(np.arange(K), Rs)                  # [N] key per op
+    offs = np.concatenate(([0], np.cumsum(Rs)[:-1]))
+    row = np.arange(N, dtype=np.int64) - offs[kid]     # [N] in-key row
+
+    live = [p for p in packs if p.R]
+
+    def cat(get):
+        return np.concatenate([np.asarray(get(p), dtype=np.int64)
+                               for p in live])
+
+    inv = cat(lambda p: p.inv_rank)
+    ret = cat(lambda p: p.ret_rank)
+    a1 = cat(lambda p: p.op_a1)
+    a2 = cat(lambda p: p.op_a2)
+    ver = cat(lambda p: p.op_ver)
+    f = cat(lambda p: p.op_f)
+    pred = cat(lambda p: p.op_pred_rank)
+    ceil = cat(lambda p: p.op_ceiling)
+    lo = cat(lambda p: p.lo[:p.R])
+    shift = cat(lambda p: p.shift)
+    uf = cat(lambda p: p.u_forced)
+    ceilb = cat(lambda p: p.ceil_beyond)
+    wv = np.repeat(np.fromiter((p.w for p in live), dtype=np.int64,
+                               count=len(live)),
+                   Rs[Rs > 0])                         # [N] window width
+
+    i32f = np.zeros((N, 4), dtype=np.int32)
+    i32f[:, 0] = inv
+    i32f[:, 1] = ret
+    u16f = np.zeros((N, 12), dtype=np.uint16)
+    u16f[:, C_A1] = np.where(a1 == WILDCARD, 0, a1 + 1)
+    u16f[:, C_A2] = a2 + 1
+    u16f[:, C_VER] = np.where(
+        ver == NO_ASSERT, U16_NOASSERT,
+        np.where((ver < 0) | (ver >= 65000), U16_NEVER, ver + 1))
+    u16f[:, C_FSK1] = f + 1
+    u16f[:, C_PRED] = np.clip(pred, 0, 65533)
+    u16f[:, C_CEIL] = np.where(ceil >= 2 ** 30, U16_INF,
+                               np.clip(ceil + 1, 0, U16_INF - 1))
+    u16f[:, C_LO] = lo
+    u16f[:, C_SHIFT] = np.clip(shift, 0, 65535)
+    u16f[:, C_CEILB] = np.where(ceilb >= 2 ** 30, U16_INF - 1,
+                                np.clip(ceilb - uf, -1, wv + 1) + 1)
+    u16f[:, C_UF] = uf
+    u16f[:, C_R] = Rs[kid]
+    i32[kid, row] = i32f
+    u16[kid, row] = u16f
     return i32, u16
 
 
@@ -739,8 +838,8 @@ def _call_single(r_pad: int, wk: int, interpret: bool):
         out_shape=jax.ShapeDtypeStruct((32, 128), jnp.int32),
         scratch_shapes=_scratch_shapes(wk),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+        compiler_params=_tpu_compiler_params(
+            pltpu, dimension_semantics=("arbitrary",)),
     )
 
     def run(i32, u16):
@@ -773,8 +872,8 @@ def _call_batch(k_keys: int, r_pad: int, wk: int, interpret: bool):
         out_shape=jax.ShapeDtypeStruct((k_keys, 32, 128), jnp.int32),
         scratch_shapes=_scratch_shapes(wk),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")),
+        compiler_params=_tpu_compiler_params(
+            pltpu, dimension_semantics=("arbitrary", "arbitrary")),
     )
 
     # inputs are compact per-op arrays shipped 2D (the tunnel moves 3D
@@ -809,13 +908,12 @@ def _call_batch_sharded(k_pad: int, r_pad: int, wk: int, n_dev: int,
     production fast path (a v5e-8 runs 8 one-chip dispatches
     concurrently instead of queueing one)."""
     import jax
-    import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     assert k_pad % n_dev == 0
     per = _call_batch(k_pad // n_dev, r_pad, wk, interpret)
     mesh = Mesh(np.array(jax.devices()[:n_dev]), ("key",))
+    shard_map, vma_kw = _shard_map()
     sharded = shard_map(
         per,
         mesh=mesh,
@@ -823,7 +921,7 @@ def _call_batch_sharded(k_pad: int, r_pad: int, wk: int, n_dev: int,
         out_specs=P("key"),
         # the pallas_call inside can't annotate varying-mesh-axes on
         # its out_shape; every output IS per-shard (key-varying)
-        check_vma=False)
+        **vma_kw)
     return jax.jit(sharded)
 
 
@@ -931,12 +1029,8 @@ def launch_packed_batch_mxu(packs: list) -> list:
             # padding keys are all-zero (R=0) rows whose grid steps die
             # at the first frontier-death check
             k_pad, n_dev = _batch_geometry(len(chunk))
-            i32s = np.zeros((k_pad, r_pad, 4), dtype=np.int32)
-            u16s = np.zeros((k_pad, r_pad, 12), dtype=np.uint16)
-            for j, i in enumerate(chunk):
-                a, b = pack_perop(packs[i], r_pad)
-                i32s[j] = a
-                u16s[j] = b
+            i32s, u16s = pack_perop_batch([packs[i] for i in chunk],
+                                          r_pad, k_pad)
             dev = _batch_call_for(k_pad, r_pad, wk, n_dev, interpret)(
                 jnp.asarray(i32s.reshape(k_pad * r_pad, 4)),
                 jnp.asarray(u16s.reshape(k_pad * r_pad, 12)))

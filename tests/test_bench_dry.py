@@ -1,0 +1,49 @@
+"""bench.py --cell <name> --dry smoke mode under tier-1.
+
+The dry path exercises the same code as each matrix cell at tiny sizes
+and asserts STRUCTURE (engine routing, packer equivalence) — never
+timings — so it is safe on any host with JAX_PLATFORMS=cpu. These tests
+pin the CLI contract: one JSON line on stdout, per-cell {"ok": true}.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_dry(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--dry", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=560)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_dry_batched_cell():
+    res = run_dry("--cell", "batched_512_keys")
+    cell = res["dry"]["batched_512_keys"]
+    assert cell["ok"] is True
+    assert cell["check"] == "_dry_batched"
+    assert cell["mxu_supported"] >= 1
+    assert cell["engines"] == ["cpu-oracle"]
+
+
+def test_dry_set_cell():
+    res = run_dry("--cell", "set_full")
+    cell = res["dry"]["set_full"]
+    assert cell["ok"] is True and cell["check"] == "_dry_set"
+    assert cell["attempts"] > 0
+
+
+def test_dry_rejects_unknown_cell():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--dry", "--cell", "nope"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0

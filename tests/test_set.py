@@ -108,3 +108,125 @@ def test_set_workload_serializable_stale_reads(tmp_path):
         "serializable": True, "store_base": str(tmp_path), "seed": 3}))
     wl = out["results"]["workload"]
     assert wl["lost-count"] == 0
+
+
+# ---------------------------------------------------------------------
+# Differential: the columnar analysis (one numpy pass) vs the reference
+# per-read sweep — the contract analyze()'s docstring promises. The
+# columnar path must produce IDENTICAL result dicts on int-valued
+# histories, including every anomaly that forces its exact full-mode
+# retry (dups, misses, out-of-order views), and fall back cleanly to
+# the reference on non-int values.
+# ---------------------------------------------------------------------
+
+import random
+
+import pytest
+
+from jepsen_etcd_tpu.checkers.set_full import (_NonColumnar,
+                                               _analyze_columnar,
+                                               _analyze_reference,
+                                               analyze)
+
+
+def gen_set_history(rng, n_ops=140, p_stale=0.0, p_dup=0.0, p_lose=0.0,
+                    p_shuffle=0.0, p_info=0.08):
+    """Concurrent set history: adds + snapshot reads over 6 processes,
+    with injectable anomalies — stale snapshot reads, duplicated
+    elements, silent loss, out-of-order (shuffled) views."""
+    ops, store, snaps = [], [], [[]]
+    pend, nxt = {}, 0
+    for _ in range(n_ops):
+        p = rng.randrange(6)
+        if p in pend:
+            kind, x = pend.pop(p)
+            if kind == "add":
+                r = rng.random()
+                if r < p_info:
+                    ops.append(Op(type="info", process=p, f="add",
+                                  value=x, error="timeout"))
+                    if rng.random() < 0.5:       # took effect anyway
+                        store.append(x)
+                        snaps.append(sorted(store))
+                elif r < p_info + 0.06:
+                    ops.append(Op(type="fail", process=p, f="add",
+                                  value=x))
+                else:
+                    ops.append(Op(type="ok", process=p, f="add",
+                                  value=x))
+                    store.append(x)
+                    if p_lose and store and rng.random() < p_lose:
+                        store.pop(rng.randrange(len(store)))
+                    snaps.append(sorted(store))
+            else:
+                view = list(snaps[-1])
+                if p_stale and len(snaps) > 1 and rng.random() < p_stale:
+                    view = list(snaps[rng.randrange(len(snaps))])
+                if p_dup and view and rng.random() < p_dup:
+                    view.append(view[rng.randrange(len(view))])
+                if p_shuffle and rng.random() < p_shuffle:
+                    rng.shuffle(view)
+                ops.append(Op(type="ok", process=p, f="read",
+                              value=view))
+        elif rng.random() < 0.55:
+            x = nxt
+            nxt += 1
+            ops.append(Op(type="invoke", process=p, f="add", value=x))
+            pend[p] = ("add", x)
+        else:
+            ops.append(Op(type="invoke", process=p, f="read",
+                          value=None))
+            pend[p] = ("read", None)
+    for p, (kind, x) in pend.items():   # stragglers stay indefinite
+        ops.append(Op(type="info", process=p, f=kind, value=x,
+                      error="never-returned"))
+    return History(ops)
+
+
+@pytest.mark.parametrize("cfg", [
+    dict(),                              # clean growing set
+    dict(p_stale=0.2),                   # flickering reads
+    dict(p_dup=0.15),                    # duplicated elements
+    dict(p_lose=0.1),                    # silent loss
+    dict(p_shuffle=0.3),                 # out-of-order views
+    dict(p_stale=0.1, p_dup=0.05, p_lose=0.05, p_shuffle=0.1),
+])
+def test_columnar_matches_reference_fuzz(cfg):
+    rng = random.Random(42 + len(cfg))
+    for trial in range(6):
+        h = gen_set_history(rng, **cfg)
+        assert _analyze_columnar(h) == _analyze_reference(h), (cfg, trial)
+
+
+def test_columnar_empty_and_read_only():
+    h0 = H()
+    assert _analyze_columnar(h0) == _analyze_reference(h0)
+    h1 = H(*flat(read(0, [])))
+    assert _analyze_columnar(h1) == _analyze_reference(h1)
+
+
+def test_columnar_fixture_equivalence():
+    """Every hand-written fixture above, both analysis paths."""
+    fixtures = [
+        H(*flat(add(0, 1), add(0, 2), read(1, [1, 2]), read(1, [1, 2]))),
+        H(*flat(add(0, 1), add(0, 2), read(1, [1, 2]), read(1, [1]))),
+        H(*flat(add(0, 1), add(0, 2), read(1, [1]), read(1, [1, 2]))),
+        H(*flat(add(0, 1), add_info(1, 9), read(2, [1]), read(2, [1]))),
+        H(*flat(add(0, 1), add_info(1, 9), read(2, [1, 9]), read(2, [1]))),
+        H(*flat(read(1, []), add(0, 1))),
+        H(*flat(add(0, 1), read(1, [1, 1]))),
+    ]
+    for i, h in enumerate(fixtures):
+        assert _analyze_columnar(h) == _analyze_reference(h), i
+
+
+def test_non_int_values_fall_back_to_reference():
+    h = H(*flat(
+        ({"type": "invoke", "process": 0, "f": "add", "value": "a"},
+         {"type": "ok", "process": 0, "f": "add", "value": "a"}),
+        ({"type": "invoke", "process": 1, "f": "read", "value": None},
+         {"type": "ok", "process": 1, "f": "read", "value": ["a"]})))
+    with pytest.raises(_NonColumnar):
+        _analyze_columnar(h)
+    assert analyze(h) == _analyze_reference(h)
+    assert SetFull().check({}, h)["valid?"] is True
