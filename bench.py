@@ -850,6 +850,32 @@ def run_dry(cell: str | None) -> int:
     return 0
 
 
+def _bench_telemetry():
+    """The bench run's telemetry recorder: the SAME span schema as a
+    test run's store/<run>/telemetry.jsonl (runner/telemetry.py pins
+    the field sets), so BENCH rounds and live runs are comparable with
+    one reader. One ``cell:<name>`` span per cell, scalar results
+    attached as attrs; deep-path spans/counters (wgl.*, mxu.*,
+    closure.*) land in the same stream because the recorder installs
+    as the process-current one. File path from
+    JEPSEN_ETCD_TPU_BENCH_TELEMETRY (unset: aggregate in memory only,
+    summary still printed)."""
+    import os
+    from jepsen_etcd_tpu.runner import telemetry
+    from jepsen_etcd_tpu.runner.telemetry import Telemetry
+    tel = Telemetry(os.environ.get("JEPSEN_ETCD_TPU_BENCH_TELEMETRY"))
+    telemetry.set_current(tel)
+    return tel
+
+
+def _run_cell(tel, name: str, fn):
+    with tel.span("cell:" + name) as sp:
+        out = fn()
+        sp.set(**{k: v for k, v in out.items()
+                  if isinstance(v, (int, float, str, bool))})
+    return out
+
+
 def main() -> int:
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
@@ -865,21 +891,29 @@ def main() -> int:
     enable_compile_cache()
     if args.dry:
         return run_dry(args.cell)
+    tel = _bench_telemetry()
     if args.cell and args.cell != "register_10k":
         fn = dict(CELLS)[args.cell]
-        print(json.dumps({args.cell: fn()}))
+        out = _run_cell(tel, args.cell, fn)
+        tel.close()
+        print(json.dumps({args.cell: out,
+                          "telemetry": tel.summary()}))
         return 0
     matrix = {}
     if not args.cell:
         for name, fn in CELLS:
             try:
-                matrix[name] = fn()
+                matrix[name] = _run_cell(tel, name, fn)
             except Exception as e:  # record, don't abort the headline
                 note(f"{name} FAILED: {e!r}")
                 matrix[name] = {"error": repr(e)}
 
-    check_s, out, p, gen_s, prep_ms, device_ms, pack_s = \
-        bench_register_10k()
+    with tel.span("cell:register_10k") as sp:
+        check_s, out, p, gen_s, prep_ms, device_ms, pack_s = \
+            bench_register_10k()
+        sp.set(check_s=check_s, gen_s=gen_s, pack_s=pack_s,
+               engine=out.get("engine"))
+    tel.close()
     print(json.dumps({
         "metric": "register_linearizability_10k_ops_check_wallclock",
         "value": round(check_s, 4),
@@ -891,6 +925,7 @@ def main() -> int:
         "engine": out.get("engine"),
         "vs_baseline": round(BASELINE_SECONDS / max(check_s, 1e-9), 1),
         "matrix": matrix,
+        "telemetry": tel.summary(),
     }))
     return 0
 

@@ -12,6 +12,7 @@ from collections import Counter, defaultdict
 from typing import Any, Optional
 
 from ..core.history import History
+from ..runner import telemetry
 
 
 class Checker:
@@ -33,8 +34,11 @@ class Compose(Checker):
         self.checkers = checkers
 
     def check(self, test, history, opts=None) -> dict:
-        results = {name: c.check(test, history, opts)
-                   for name, c in self.checkers.items()}
+        tel = telemetry.current()
+        results = {}
+        for name, c in self.checkers.items():
+            with tel.span("checker:" + str(name)):
+                results[name] = c.check(test, history, opts)
         return {"valid?": _merge_valid([r.get("valid?") for r in
                                         results.values()]),
                 **results}
@@ -43,13 +47,15 @@ class Compose(Checker):
         """Per-key batch entry (called by checkers.Independent): children
         that are batch-aware (the TPU kernel) get the whole key batch in
         one call; the rest run per key."""
+        tel = telemetry.current()
         per_key: dict = {k: {} for k in subhistories}
         for name, c in self.checkers.items():
-            if hasattr(c, "check_batch"):
-                outs = c.check_batch(test, subhistories, opts)
-            else:
-                outs = {k: c.check(test, sub, opts)
-                        for k, sub in subhistories.items()}
+            with tel.span("checker:" + str(name), keys=len(subhistories)):
+                if hasattr(c, "check_batch"):
+                    outs = c.check_batch(test, subhistories, opts)
+                else:
+                    outs = {k: c.check(test, sub, opts)
+                            for k, sub in subhistories.items()}
             for k, r in outs.items():
                 per_key[k][name] = r
         return {k: {"valid?": _merge_valid([r.get("valid?")

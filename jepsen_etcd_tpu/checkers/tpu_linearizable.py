@@ -13,10 +13,21 @@ import logging
 from typing import Optional
 
 from ..models import VersionedRegister
+from ..runner import telemetry
 from .core import Checker
 from .linearizable import check_history
 
 logger = logging.getLogger("jepsen_etcd_tpu.checkers")
+
+
+def _tally_engine(out: dict) -> dict:
+    """Count which engine produced this verdict (``engine.mxu-wave``,
+    ``engine.jnp-ladder``, ``engine.cpu-oracle``) into the run's
+    telemetry, so results.json shows the routing split per run."""
+    telemetry.current().counter(
+        "engine." + str(out.get("engine") or out.get("checker")
+                        or "unknown"))
+    return out
 
 #: histories at or below this many entries (invoke + completion) route
 #: to the native DFS before any device packing: TPU dispatch costs
@@ -252,6 +263,9 @@ class TPULinearizableChecker(Checker):
         return self._fallback(history, reason, blowup=blowup)
 
     def check(self, test, history, opts=None, _band=None) -> dict:
+        return _tally_engine(self._check(test, history, opts, _band))
+
+    def _check(self, test, history, opts=None, _band=None) -> dict:
         from ..ops import wgl
         small, small_unknown, band_budget = \
             self._small_history_check(history) if _band is None else _band
@@ -262,7 +276,8 @@ class TPULinearizableChecker(Checker):
             return self._fallback_after_band(
                 history, "model has no kernel packing", False,
                 small_unknown, band_budget)
-        p = pack(history)
+        with telemetry.current().span("wgl.pack", ops=len(history)):
+            p = pack(history)
         if not p.ok:
             return self._fallback_after_band(
                 history, p.reason, bool(p.blowup),
@@ -316,7 +331,7 @@ class TPULinearizableChecker(Checker):
             band = self._small_history_check(subhistories[k],
                                              band=batch_band)
             if band[0] is not None:
-                results[k] = band[0]
+                results[k] = _tally_engine(band[0])
             else:
                 big_keys.append(k)
                 bands[k] = band
@@ -341,7 +356,9 @@ class TPULinearizableChecker(Checker):
         # degrade-don't-crash on Mosaic failures all apply to this
         # production path exactly as inside check_packed_batch.
         from ..ops import wgl_mxu
-        packed = pack_batch({k: subhistories[k] for k in big_keys})
+        with telemetry.current().span("wgl.pack-batch",
+                                      keys=len(big_keys)):
+            packed = pack_batch({k: subhistories[k] for k in big_keys})
         packs = [packed[k] for k in big_keys]
         outs: list = [None] * len(big_keys)
         if self.f_max is None:
@@ -367,8 +384,9 @@ class TPULinearizableChecker(Checker):
         # _finalize routes those through the CPU fallback (and top-rung
         # overflows through the DFS-then-spill ordering), skipping any
         # DFS the band already ran at sufficient budget
-        results.update({k: self._finalize(subhistories[k], out, pack=p,
-                                          band=bands[k])
+        results.update({k: _tally_engine(
+                            self._finalize(subhistories[k], out, pack=p,
+                                           band=bands[k]))
                         for (k, out, p) in zip(big_keys, outs, packs)})
         return results
 

@@ -95,6 +95,10 @@ def build_parser() -> argparse.ArgumentParser:
         s.add_argument("--tcpdump", action="store_true",
                        help="record a message-level network trace to "
                             "store/<run>/trace.jsonl (db.clj:276-277)")
+        s.add_argument("--no-telemetry", action="store_true",
+                       help="skip writing store/<run>/telemetry.jsonl "
+                            "(phase/checker spans and kernel counters "
+                            "are on by default)")
         s.add_argument("--test-count", type=int, default=1)
         s.add_argument("--only-workloads-expected-to-pass",
                        action="store_true")
@@ -167,6 +171,7 @@ def opts_from_args(args) -> dict:
         "seed": args.seed,
         "debug": args.debug,
         "tcpdump": args.tcpdump,
+        "no_telemetry": getattr(args, "no_telemetry", False),
         "store_base": args.store,
     }
 

@@ -14,7 +14,8 @@ from .generators import (phases, stagger, time_limit, nemesis as gen_nemesis,
                          clients as gen_clients, log as gen_log, sleep_gen)
 from .workloads import workloads
 from .checkers import (compose as compose_checkers, Stats,
-                       UnhandledExceptions, LogFilePattern, ClockPlot, Perf)
+                       UnhandledExceptions, LogFilePattern, ClockPlot,
+                       Perf, TimelineHtml)
 from .db import db as make_db
 from .nemesis import nemesis_package
 from .runner.sim import SECOND
@@ -47,6 +48,8 @@ def default_opts() -> dict:
         "corrupt_check": False,         # etcd.clj:164
         "seed": 0,
         "debug": False,
+        "no_telemetry": False,          # every run writes telemetry.jsonl
+                                        # unless opted out (--no-telemetry)
         "version": "sim-3.5.6",         # etcd.clj:206-207 (pinned: the sim
                                         # has exactly one "binary")
     }
@@ -158,6 +161,10 @@ def etcd_test(opts: dict) -> dict:
 
     checker = compose_checkers({
         "perf": Perf(nemesis_perf=nem.get("perf", [])),
+        # top level, not per workload: the full history (nemesis ops
+        # included) renders the positioned timeline with fault bands;
+        # a per-key subhistory would lose both
+        "timeline": TimelineHtml(nemesis_perf=nem.get("perf", [])),
         "clock": ClockPlot(),
         "stats": Stats(),
         "exceptions": UnhandledExceptions(),

@@ -27,6 +27,7 @@ from functools import partial
 
 import numpy as np
 
+from ..runner import telemetry
 from .common import HAVE_JAX, bucket as _bucket, use_device
 
 if HAVE_JAX:
@@ -238,11 +239,13 @@ def closure_levels_lazy(et_edges: list, lvl_mask: np.ndarray, n: int,
     if rt_vecs is not None:
         inv_v[:n] = rt_vecs[0]
         comp_v[:n] = rt_vecs[1]
-    reach_dev, on_cycle = _closure_from_edges(
-        jnp.asarray(epad), jnp.asarray(lvl_mask),
-        jnp.asarray(inv_v), jnp.asarray(comp_v),
-        b, m, iters, n_types)
-    on_cycle = np.asarray(on_cycle)[:, :n]
+    with telemetry.current().span("closure.device", n=n, b=b,
+                                  compact=True, edges=len(edges)):
+        reach_dev, on_cycle = _closure_from_edges(
+            jnp.asarray(epad), jnp.asarray(lvl_mask),
+            jnp.asarray(inv_v), jnp.asarray(comp_v),
+            b, m, iters, n_types)
+        on_cycle = np.asarray(on_cycle)[:, :n]
     cache: list = []
 
     def reach_fn():
@@ -273,7 +276,8 @@ def closure_batch_lazy(adj: np.ndarray, force_device: bool | None = None):
         empty = np.zeros((b, 0, 0), bool)
         return (lambda: empty), np.zeros((b, 0), bool)
     if not use_device(force_device, n, CPU_CUTOFF, "closure_batch"):
-        reach, on_cycle = _closure_numpy(adj)
+        with telemetry.current().span("closure.host", n=n, b=b):
+            reach, on_cycle = _closure_numpy(adj)
         return (lambda: reach), on_cycle
     m = _bucket(n)
     n_dev = len(jax.devices())
@@ -282,11 +286,15 @@ def closure_batch_lazy(adj: np.ndarray, force_device: bool | None = None):
     pad = np.zeros((b, m, m), dtype=bool)
     pad[:, :n, :n] = adj
     iters = max(1, math.ceil(math.log2(m)))
-    if n_dev > 1 and m >= SHARD_CUTOFF:
-        reach_dev, on_cycle = _closure_device_sharded(pad, iters)
-    else:
-        reach_dev, on_cycle = _closure_device(jnp.asarray(pad), iters)
-    on_cycle = np.asarray(on_cycle)[:, :n]
+    with telemetry.current().span("closure.device", n=n, b=b,
+                                  sharded=(n_dev > 1
+                                           and m >= SHARD_CUTOFF)):
+        if n_dev > 1 and m >= SHARD_CUTOFF:
+            reach_dev, on_cycle = _closure_device_sharded(pad, iters)
+        else:
+            reach_dev, on_cycle = _closure_device(jnp.asarray(pad),
+                                                  iters)
+        on_cycle = np.asarray(on_cycle)[:, :n]
     cache: list = []
 
     def reach_fn():

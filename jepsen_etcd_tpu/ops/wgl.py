@@ -76,6 +76,7 @@ def _run_fused(broken: list, name: str, call):
         return None
 
 from ..checkers.linearizable import Entry, history_entries
+from ..runner import telemetry
 from .common import UnsupportedValue, ValueIds, as_version
 
 W = 32          # single-word window width (fast path)
@@ -1520,10 +1521,19 @@ def spill_packed(p: Packed, tables, frontier, waves_done: int) -> dict:
     """Budgeted host-spill continuation from a frozen frontier — the
     entry point for resuming a ``check_packed(..., spill=False)``
     overflow (its ``_resume`` payload) without re-climbing the ladder."""
-    return _spill_bfs(p, tables, frontier, waves_done,
-                      state_budget=SPILL_STATE_BUDGET
-                      if p.I < SPILL_I_LIMIT
-                      else SPILL_STATE_BUDGET_HIGH_I)
+    tel = telemetry.current()
+    tel.counter("wgl.host-spill")
+    with tel.span("wgl.spill", ops=p.R, w=p.w) as sp:
+        out = _spill_bfs(p, tables, frontier, waves_done,
+                         state_budget=SPILL_STATE_BUDGET
+                         if p.I < SPILL_I_LIMIT
+                         else SPILL_STATE_BUDGET_HIGH_I)
+        sp.set(valid=out.get("valid?"),
+               peak_frontier=out.get("peak-frontier"),
+               states=out.get("states"))
+    if out.get("peak-frontier"):
+        tel.counter("wgl.max-frontier", out["peak-frontier"], mode="max")
+    return out
 
 
 def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
@@ -1740,12 +1750,17 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
     else:
         put = jnp.asarray
     tables_dev = {k: put(v) for k, v in stacked.items()}
-    valid, overflow, waves, peak, _frontier = _batched_kernel_jitted(
-        f_max, w)(tables_dev, put(Rs), put(Is))
-    valid = np.asarray(valid)
+    tel = telemetry.current()
+    with tel.span("wgl.batch-dispatch", keys=K, w=w, f_max=f_max):
+        valid, overflow, waves, peak, _frontier = _batched_kernel_jitted(
+            f_max, w)(tables_dev, put(Rs), put(Is))
+        valid = np.asarray(valid)
     overflow = np.asarray(overflow)
     waves = np.asarray(waves)
     peak = np.asarray(peak)
+    tel.counter("wgl.dispatches")
+    if peak.size:
+        tel.counter("wgl.max-frontier", int(peak.max()), mode="max")
     for j, i in enumerate(idxs):
         p = packs[i]
         if overflow[j]:
@@ -1765,6 +1780,27 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
 
 def check_packed(p: Packed, f_max: Optional[int] = None,
                  spill: bool = True) -> dict:
+    """Telemetry shell around :func:`_check_packed_impl`: one span per
+    dispatch (per-dispatch wall time), plus the routing counters a run's
+    results.json surfaces (dispatch count, rung total, peak frontier
+    width across the run)."""
+    tel = telemetry.current()
+    with tel.span("wgl.check_packed", ops=getattr(p, "R", None),
+                  w=getattr(p, "w", None)) as sp:
+        out = _check_packed_impl(p, f_max=f_max, spill=spill)
+        sp.set(engine=out.get("engine"), valid=out.get("valid?"),
+               rungs=out.get("rungs"), waves=out.get("waves"),
+               peak_frontier=out.get("peak-frontier"))
+    tel.counter("wgl.dispatches")
+    if out.get("rungs"):
+        tel.counter("wgl.rungs", out["rungs"])
+    if out.get("peak-frontier"):
+        tel.counter("wgl.max-frontier", out["peak-frontier"], mode="max")
+    return out
+
+
+def _check_packed_impl(p: Packed, f_max: Optional[int] = None,
+                       spill: bool = True) -> dict:
     """Run the kernel on one packed history (host->device->host).
 
     f_max defaults small (tiny sorts, fast waves — healthy frontiers
@@ -1842,9 +1878,11 @@ def check_packed(p: Packed, f_max: Optional[int] = None,
                         jnp.asarray(i0), jnp.asarray(v0),
                         jnp.int32(1))
     peak_all = max(peak_all, int(peak))
+    rungs = 1
     for f_next in ladder[1:]:
         if not bool(overflow):
             break
+        rungs += 1
         # pad the frozen frontier to the next rung and resume in place
         dvec, wvec, ivec, vvec, n_alive = frontier
         f_cur = dvec.shape[0]
@@ -1870,11 +1908,15 @@ def check_packed(p: Packed, f_max: Optional[int] = None,
             return {"valid?": "unknown", "overflow": True,
                     "reason": "frontier overflow past the top rung",
                     "peak-frontier": peak_all, "ops": p.R,
-                    "info-ops": p.I,
+                    "info-ops": p.I, "rungs": rungs,
+                    "engine": "jnp-ladder",
                     "_resume": (tables, frontier, int(k))}
         out = spill_packed(p, tables, frontier, int(k))
         out["peak-frontier"] = max(peak_all, out.get("peak-frontier", 0))
+        out["rungs"] = rungs
+        out.setdefault("engine", "jnp-ladder")
         return out
     return {"valid?": valid, "waves": int(k), "peak-frontier": peak_all,
-            "ops": p.R, "info-ops": p.I,
+            "ops": p.R, "info-ops": p.I, "rungs": rungs,
+            "engine": "jnp-ladder",
             **({} if valid else {"stuck-at-depth": int(k)})}

@@ -56,6 +56,7 @@ import functools
 
 import numpy as np
 
+from ..runner import telemetry
 from .wgl import (CAS, NO_ASSERT, READ, WRITE, WILDCARD,
                   Packed, bucket)
 
@@ -998,12 +999,18 @@ def check_packed_mxu(p: Packed) -> dict | None:
 
     if not supported(p):
         return None
+    tel = telemetry.current()
     r_pad = max(bucket(p.R), TSUB)
     i32, u16 = pack_perop(p, r_pad)
     interpret = jax.default_backend() != "tpu"
-    out = np.asarray(_call_single(r_pad, p.w, interpret)(
-        jnp.asarray(i32), jnp.asarray(u16)))
-    return _decode(out, p)
+    with tel.span("mxu.dispatch", ops=p.R, w=p.w) as sp:
+        out = np.asarray(_call_single(r_pad, p.w, interpret)(
+            jnp.asarray(i32), jnp.asarray(u16)))
+        res = _decode(out, p)
+        sp.set(valid=res.get("valid?"),
+               peak_frontier=res.get("peak-frontier"))
+    tel.counter("mxu.dispatches")
+    return res
 
 
 def launch_packed_batch_mxu(packs: list) -> list:
@@ -1016,35 +1023,44 @@ def launch_packed_batch_mxu(packs: list) -> list:
     import jax.numpy as jnp
 
     interpret = jax.default_backend() != "tpu"
+    tel = telemetry.current()
     groups: dict = {}
     for i, p in enumerate(packs):
         if supported(p):
             groups.setdefault((max(bucket(p.R), TSUB), p.w), []).append(i)
     launched = []
-    for (r_pad, wk), idxs in groups.items():
-        for lo_i in range(0, len(idxs), BATCH_CHUNK):
-            chunk = idxs[lo_i:lo_i + BATCH_CHUNK]
-            # bucket the chunk count so the jit cache holds O(log K)
-            # variants instead of one compile per distinct batch size;
-            # padding keys are all-zero (R=0) rows whose grid steps die
-            # at the first frontier-death check
-            k_pad, n_dev = _batch_geometry(len(chunk))
-            i32s, u16s = pack_perop_batch([packs[i] for i in chunk],
-                                          r_pad, k_pad)
-            dev = _batch_call_for(k_pad, r_pad, wk, n_dev, interpret)(
-                jnp.asarray(i32s.reshape(k_pad * r_pad, 4)),
-                jnp.asarray(u16s.reshape(k_pad * r_pad, 12)))
-            launched.append((chunk, dev, [packs[i] for i in chunk]))
+    with tel.span("mxu.launch", keys=len(packs)) as sp:
+        for (r_pad, wk), idxs in groups.items():
+            for lo_i in range(0, len(idxs), BATCH_CHUNK):
+                chunk = idxs[lo_i:lo_i + BATCH_CHUNK]
+                # bucket the chunk count so the jit cache holds O(log K)
+                # variants instead of one compile per distinct batch
+                # size; padding keys are all-zero (R=0) rows whose grid
+                # steps die at the first frontier-death check
+                k_pad, n_dev = _batch_geometry(len(chunk))
+                i32s, u16s = pack_perop_batch([packs[i] for i in chunk],
+                                              r_pad, k_pad)
+                dev = _batch_call_for(k_pad, r_pad, wk, n_dev,
+                                      interpret)(
+                    jnp.asarray(i32s.reshape(k_pad * r_pad, 4)),
+                    jnp.asarray(u16s.reshape(k_pad * r_pad, 12)))
+                launched.append((chunk, dev,
+                                 [packs[i] for i in chunk]))
+        sp.set(chunks=len(launched),
+               supported=sum(len(v) for v in groups.values()))
+    tel.counter("mxu.dispatches", len(launched))
     return launched
 
 
 def collect_packed_batch_mxu(launched: list, results: list) -> None:
     """Read back launch records from ``launch_packed_batch_mxu`` and
     decode into ``results`` (indexed as the original pack list)."""
-    for chunk, dev, chunk_packs in launched:
-        out = np.asarray(dev)
-        for j, (i, p) in enumerate(zip(chunk, chunk_packs)):
-            results[i] = _decode(out[j], p)
+    with telemetry.current().span("mxu.collect",
+                                  chunks=len(launched)):
+        for chunk, dev, chunk_packs in launched:
+            out = np.asarray(dev)
+            for j, (i, p) in enumerate(zip(chunk, chunk_packs)):
+                results[i] = _decode(out[j], p)
 
 
 def check_packed_batch_mxu(packs: list) -> list | None:

@@ -1,0 +1,263 @@
+"""Structured run telemetry: spans, counters, and events.
+
+Every phase of a run — setup, generation, history packing, each
+checker, each device dispatch — emits into the process-current
+``Telemetry`` recorder, which streams one JSON record per line to
+``store/<run>/telemetry.jsonl`` and aggregates in memory so the run's
+``results.json`` can carry a summary (phase totals, per-checker span
+totals, TPU-path counters). The reference treats run artifacts as
+first-class evidence (timeline/html at register.clj:112, perf plots,
+per-node pcaps); telemetry is the same idea applied to the checker
+economics this port exists to measure: a single run's artifacts explain
+its own checker cost the way PERF.md's bench cells do.
+
+Record schema (one JSON object per line; ``SPAN_FIELDS`` /
+``COUNTER_FIELDS`` / ``EVENT_FIELDS`` pin the field sets — bench.py
+emits the same schema per cell so BENCH rounds and live runs are
+comparable with one reader):
+
+    {"kind": "span",    "name": ..., "t0": ..., "t1": ...,
+     "dur_s": ..., "attrs": {...}}
+    {"kind": "counter", "name": ..., "value": ...}
+    {"kind": "event",   "name": ..., "t": ..., "attrs": {...}}
+
+Span-name conventions: ``phase:<name>`` for run phases (setup,
+generate, teardown, check, save), ``checker:<name>`` for one composed
+checker's pass, everything else dotted by subsystem (``wgl.check_packed``,
+``mxu.launch``, ``closure.device``). Times are ``time.monotonic()``
+wall seconds — telemetry measures host/device cost, not virtual time.
+
+Deep code (ops/, checkers/) reaches the recorder through ``current()``,
+which returns a no-op ``NullTelemetry`` outside a run, so kernels and
+packers are instrumentable without threading a handle through every
+call — and pay only an attribute lookup when telemetry is off.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Optional
+
+SCHEMA_VERSION = 1
+
+#: the pinned field sets — a record of each kind carries exactly these
+SPAN_FIELDS = ("kind", "name", "t0", "t1", "dur_s", "attrs")
+COUNTER_FIELDS = ("kind", "name", "value")
+EVENT_FIELDS = ("kind", "name", "t", "attrs")
+
+#: total record cap per run: past it records are counted as dropped,
+#: never buffered (a pathological dispatch loop must not eat the disk)
+MAX_RECORDS = 200_000
+
+
+class _Span:
+    """Context manager for one span; ``set(**attrs)`` attaches result
+    attributes (engine, rung count, ...) before the span closes."""
+
+    __slots__ = ("_tel", "name", "attrs", "t0")
+
+    def __init__(self, tel: "Telemetry", name: str, attrs: dict):
+        self._tel = tel
+        self.name = name
+        self.attrs = attrs
+        self.t0 = 0.0
+
+    def set(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "_Span":
+        self.t0 = self._tel._clock()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._tel._end_span(self)
+
+
+class _NullSpan:
+    """No-op span: zero work outside a run."""
+
+    __slots__ = ()
+
+    def set(self, **attrs: Any) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTelemetry:
+    """The recorder used outside a run: every call is a no-op."""
+
+    enabled = False
+
+    def span(self, name: str, **attrs: Any) -> _NullSpan:
+        return _NULL_SPAN
+
+    def counter(self, name: str, value: float = 1,
+                mode: str = "sum") -> None:
+        pass
+
+    def event(self, name: str, **attrs: Any) -> None:
+        pass
+
+    def summary(self) -> dict:
+        return {}
+
+    def close(self) -> None:
+        pass
+
+
+NULL = NullTelemetry()
+
+
+class Telemetry:
+    """Span/counter recorder streaming to a .jsonl file.
+
+    Thread-safe: live runs complete ops from socket threads, and a
+    counter bump must never corrupt the stream. The file opens lazily
+    on the first record and every record is written (buffered by the
+    underlying file object) as it happens — a crashed run keeps the
+    spans it completed.
+    """
+
+    enabled = True
+
+    def __init__(self, path: Optional[str] = None,
+                 clock=time.monotonic,
+                 max_records: int = MAX_RECORDS):
+        self.path = path
+        self._clock = clock
+        self._fh = None
+        self._lock = threading.Lock()
+        self._max_records = max_records
+        self.records = 0
+        self.dropped = 0
+        # name -> [count, total_s]; insertion-ordered like the file
+        self._span_agg: dict[str, list] = {}
+        # name -> value; mode "max" counters keep the running max
+        self._counters: dict[str, float] = {}
+        self._closed = False
+
+    # -- recording -----------------------------------------------------------
+    def span(self, name: str, **attrs: Any) -> _Span:
+        return _Span(self, name, attrs)
+
+    def _end_span(self, sp: _Span) -> None:
+        t1 = self._clock()
+        dur = t1 - sp.t0
+        with self._lock:
+            agg = self._span_agg.setdefault(sp.name, [0, 0.0])
+            agg[0] += 1
+            agg[1] += dur
+            self._write({"kind": "span", "name": sp.name,
+                         "t0": sp.t0, "t1": t1, "dur_s": dur,
+                         "attrs": sp.attrs})
+
+    def counter(self, name: str, value: float = 1,
+                mode: str = "sum") -> None:
+        """Accumulate a named counter; ``mode="max"`` keeps the running
+        maximum (e.g. peak frontier width) instead of the sum. Counters
+        are flushed as records at close, not per bump."""
+        with self._lock:
+            if mode == "max":
+                self._counters[name] = max(
+                    self._counters.get(name, value), value)
+            else:
+                self._counters[name] = self._counters.get(name, 0) + value
+
+    def event(self, name: str, **attrs: Any) -> None:
+        with self._lock:
+            self._write({"kind": "event", "name": name,
+                         "t": self._clock(), "attrs": attrs})
+
+    def _write(self, rec: dict) -> None:
+        # caller holds the lock
+        if self._closed:
+            return
+        if self.records >= self._max_records:
+            self.dropped += 1
+            return
+        self.records += 1
+        if self.path is None:
+            return
+        if self._fh is None:
+            self._fh = open(self.path, "w")
+        self._fh.write(json.dumps(rec, default=repr) + "\n")
+
+    # -- reading -------------------------------------------------------------
+    def summary(self) -> dict:
+        """Aggregate view for results.json: per-span-name totals (the
+        file's span records sum to exactly these — same floats, same
+        order), counters, and the phase / per-checker convenience maps
+        derived from the span-name conventions."""
+        with self._lock:
+            spans = {name: {"count": c, "total_s": t}
+                     for name, (c, t) in self._span_agg.items()}
+            counters = dict(self._counters)
+            dropped = self.dropped
+        out = {
+            "schema": SCHEMA_VERSION,
+            "spans": spans,
+            "counters": counters,
+            "phases": {n[len("phase:"):]: v["total_s"]
+                       for n, v in spans.items()
+                       if n.startswith("phase:")},
+            "checkers": {n[len("checker:"):]: v["total_s"]
+                         for n, v in spans.items()
+                         if n.startswith("checker:")},
+        }
+        if dropped:
+            out["dropped"] = dropped
+        if self.path is not None:
+            import os
+            out["file"] = os.path.basename(self.path)
+        return out
+
+    def close(self) -> None:
+        """Flush counters as records and close the stream. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            for name, value in self._counters.items():
+                if self.records < self._max_records:
+                    self.records += 1
+                    if self.path is not None:
+                        if self._fh is None:
+                            self._fh = open(self.path, "w")
+                        self._fh.write(json.dumps(
+                            {"kind": "counter", "name": name,
+                             "value": value}) + "\n")
+                else:
+                    self.dropped += 1
+            if self.dropped and self._fh is not None:
+                self._fh.write(json.dumps(
+                    {"kind": "event", "name": "telemetry.dropped",
+                     "t": self._clock(),
+                     "attrs": {"dropped": self.dropped}}) + "\n")
+            self._closed = True
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+#: the process-current recorder; NULL outside a run
+_current: Any = NULL
+
+
+def current() -> Any:
+    """The active run's Telemetry, or the no-op NULL outside a run."""
+    return _current
+
+
+def set_current(tel: Optional[Telemetry]) -> None:
+    """Install (or with None, clear) the process-current recorder."""
+    global _current
+    _current = tel if tel is not None else NULL

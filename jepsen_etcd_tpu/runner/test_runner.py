@@ -18,8 +18,21 @@ from ..sut.errors import SimError
 from .sim import SimLoop, set_current_loop, current_loop
 from .interpreter import interpret
 from .store import make_store_dir, save_run
+from . import telemetry
+from .telemetry import Telemetry
 
 logger = logging.getLogger("jepsen_etcd_tpu.run")
+
+
+def _make_telemetry(test: dict, store_dir: str):
+    """Install the run's telemetry recorder (``--no-telemetry`` opts
+    out; every other run writes telemetry.jsonl with no flag needed)."""
+    if test.get("no_telemetry"):
+        return None
+    import os
+    tel = Telemetry(os.path.join(store_dir, "telemetry.jsonl"))
+    telemetry.set_current(tel)
+    return tel
 
 
 class ClientPool:
@@ -94,6 +107,7 @@ def run_test(test: dict) -> dict:
     store_dir = make_store_dir(test.get("store_base", "store"),
                                test.get("name", "test"))
     test["store_dir"] = store_dir
+    tel = _make_telemetry(test, store_dir)
     try:
         # thread the reference's SUT knobs from opts into the cluster
         # (etcd.clj:164,197-204 -> db.clj:88-99); an explicit
@@ -111,8 +125,12 @@ def run_test(test: dict) -> dict:
         test["cluster"] = cluster
         if test.get("tcpdump"):
             # network-event trace (the --tcpdump analog, db.clj:276-277)
+            # streaming straight to the run dir — events are never
+            # buffered past the write-behind window
+            import os
             from .trace import NetTrace
-            cluster.tracer = NetTrace(loop)
+            cluster.tracer = NetTrace(
+                loop, path=os.path.join(store_dir, "trace.jsonl"))
         db = test["db"]
         pool = ClientPool(test)
         nemesis_obj = test.get("nemesis")
@@ -127,26 +145,31 @@ def run_test(test: dict) -> dict:
                 return await nemesis_obj.invoke(test, op)
 
         async def main() -> History:
+            tel_now = telemetry.current()
             logger.info("Setting up DB on %s", test["nodes"])
-            await db.setup(test)
-            if nemesis_obj is not None:
-                await nemesis_obj.setup(test)
-            await pool.setup_initial(test["concurrency"])
+            with tel_now.span("phase:setup", nodes=len(test["nodes"])):
+                await db.setup(test)
+                if nemesis_obj is not None:
+                    await nemesis_obj.setup(test)
+                await pool.setup_initial(test["concurrency"])
             logger.info("Running generator")
-            h = await interpret(test, test["generator"], invoke,
-                                test["concurrency"],
-                                nemesis_invoke=nemesis_invoke)
-            await pool.teardown()
-            if nemesis_obj is not None:
-                await nemesis_obj.teardown(test)
-            await db.teardown(test)
-            # grace: let closed clients' pumps observe closure and
-            # timed-out rpcs cancel before the leak scan — derived from
-            # the client timeout so raising TIMEOUT can't cause
-            # spurious task-leak reports
-            from .sim import sleep, SECOND
-            from ..client.base import TIMEOUT
-            await sleep(TIMEOUT + 1 * SECOND)
+            with tel_now.span("phase:generate") as sp:
+                h = await interpret(test, test["generator"], invoke,
+                                    test["concurrency"],
+                                    nemesis_invoke=nemesis_invoke)
+                sp.set(ops=len(h))
+            with tel_now.span("phase:teardown"):
+                await pool.teardown()
+                if nemesis_obj is not None:
+                    await nemesis_obj.teardown(test)
+                await db.teardown(test)
+                # grace: let closed clients' pumps observe closure and
+                # timed-out rpcs cancel before the leak scan — derived
+                # from the client timeout so raising TIMEOUT can't cause
+                # spurious task-leak reports
+                from .sim import sleep, SECOND
+                from ..client.base import TIMEOUT
+                await sleep(TIMEOUT + 1 * SECOND)
             return h
 
         history = loop.run_coro(main())
@@ -160,11 +183,14 @@ def run_test(test: dict) -> dict:
         except SimError as e:
             logger.error("task leak detected: %s", e)
             task_leak = str(e)
+        set_current_loop(None)
+        return _analyze_and_save(test, history, store_dir, cluster,
+                                 task_leak, sim_seconds, t0)
     finally:
         set_current_loop(None)
-
-    return _analyze_and_save(test, history, store_dir, cluster,
-                             task_leak, sim_seconds, t0)
+        telemetry.set_current(None)
+        if tel is not None:
+            tel.close()
 
 
 def _analyze_and_save(test: dict, history, store_dir: str, cluster,
@@ -175,8 +201,10 @@ def _analyze_and_save(test: dict, history, store_dir: str, cluster,
     runs (no simulated nodes, no trace); node_logs overrides the
     cluster-derived logs (the local control plane collects its own)."""
     logger.info("Analyzing %d ops (history in %s)", len(history), store_dir)
-    results = test["checker"].check(test, history,
-                                    {"store_dir": store_dir})
+    tel = telemetry.current()
+    with tel.span("phase:check", ops=len(history)):
+        results = test["checker"].check(test, history,
+                                        {"store_dir": store_dir})
     if task_leak is not None:
         results["task-leak"] = {"valid?": False, "error": task_leak}
         results["valid?"] = False
@@ -192,11 +220,15 @@ def _analyze_and_save(test: dict, history, store_dir: str, cluster,
         node_logs = {} if cluster is None else {
             name: list(node.etcd_log)
             for name, node in cluster.nodes.items()}
-    save_run(store_dir, test, history, results, node_logs)
+    # the trace streams during the run; close it and fold its totals
+    # into results BEFORE save_run so results.json carries them
     if cluster is not None and cluster.tracer is not None:
-        import os
-        with open(os.path.join(store_dir, "trace.jsonl"), "w") as f:
-            f.write(cluster.tracer.to_jsonl())
+        cluster.tracer.close()
+        results["net-trace"] = cluster.tracer.summary()
+    if tel.enabled:
+        results["telemetry"] = tel.summary()
+    with tel.span("phase:save"):
+        save_run(store_dir, test, history, results, node_logs)
     wall = wall_time.time() - t0
     logger.info("Run complete: valid?=%s (%d ops, %.1f sim-s, %.2f wall-s)",
                 results.get("valid?"), len(history), sim_seconds, wall)
@@ -224,6 +256,7 @@ def run_test_live(test: dict) -> dict:
                                test.get("name", "test"))
     test["store_dir"] = store_dir
     test["cluster"] = None  # cluster-reading checkers no-op on None
+    tel = _make_telemetry(test, store_dir)
     try:
         db = test["db"]
         pool = ClientPool(test)
@@ -239,25 +272,31 @@ def run_test_live(test: dict) -> dict:
                 return await nemesis_obj.invoke(test, op)
 
         async def main() -> History:
+            tel_now = telemetry.current()
             logger.info("Awaiting live cluster %s", test["nodes"])
-            await db.setup(test)
-            if nemesis_obj is not None:
-                await nemesis_obj.setup(test)
-            await pool.setup_initial(test["concurrency"])
+            with tel_now.span("phase:setup", nodes=len(test["nodes"])):
+                await db.setup(test)
+                if nemesis_obj is not None:
+                    await nemesis_obj.setup(test)
+                await pool.setup_initial(test["concurrency"])
             logger.info("Running generator (wall clock)")
-            h = await interpret(test, test["generator"], invoke,
-                                test["concurrency"],
-                                nemesis_invoke=nemesis_invoke)
-            await pool.teardown()
-            if nemesis_obj is not None:
-                await nemesis_obj.teardown(test)
-            await db.teardown(test)
-            # grace before the leak scan: same TIMEOUT-derived bound as
-            # the sim path, so in-flight rpcs and keepalive pumps
-            # (interval LEASE_TTL/3 < TIMEOUT) can observe closure
-            from .sim import sleep, SECOND
-            from ..client.base import TIMEOUT
-            await sleep(TIMEOUT + 1 * SECOND)
+            with tel_now.span("phase:generate") as sp:
+                h = await interpret(test, test["generator"], invoke,
+                                    test["concurrency"],
+                                    nemesis_invoke=nemesis_invoke)
+                sp.set(ops=len(h))
+            with tel_now.span("phase:teardown"):
+                await pool.teardown()
+                if nemesis_obj is not None:
+                    await nemesis_obj.teardown(test)
+                await db.teardown(test)
+                # grace before the leak scan: same TIMEOUT-derived
+                # bound as the sim path, so in-flight rpcs and
+                # keepalive pumps (interval LEASE_TTL/3 < TIMEOUT) can
+                # observe closure
+                from .sim import sleep, SECOND
+                from ..client.base import TIMEOUT
+                await sleep(TIMEOUT + 1 * SECOND)
             return h
 
         history = loop.run_coro(main())
@@ -268,13 +307,17 @@ def run_test_live(test: dict) -> dict:
         except SimError as e:
             logger.error("task leak detected: %s", e)
             task_leak = str(e)
+        set_current_loop(None)
+        loop.shutdown()
+        # local-mode node logs come from the control plane's per-node
+        # capture files (db.clj:234-242); plain live mode has no shell
+        # on the nodes, so its log_files() is empty
+        return _analyze_and_save(test, history, store_dir, None,
+                                 task_leak, sim_seconds, t0,
+                                 node_logs=db.log_files(test))
     finally:
         set_current_loop(None)
         loop.shutdown()
-
-    # local-mode node logs come from the control plane's per-node
-    # capture files (db.clj:234-242); plain live mode has no shell on
-    # the nodes, so its log_files() is empty
-    return _analyze_and_save(test, history, store_dir, None,
-                             task_leak, sim_seconds, t0,
-                             node_logs=db.log_files(test))
+        telemetry.set_current(None)
+        if tel is not None:
+            tel.close()

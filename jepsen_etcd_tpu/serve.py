@@ -3,8 +3,13 @@
 Re-designs the reference's ``lein run serve`` (etcd.clj:250-252, jepsen's
 built-in web server): ``/`` renders a run index (name, time, ops,
 valid? badge); each run dir renders a report page — test parameters,
-per-checker verdicts, inline perf/clock plots, artifact links — with
-plain file serving below it (``?files`` forces the raw listing).
+per-checker verdicts, telemetry phase/counter summary, inline
+perf/clock plots, artifact links — with plain file serving below it
+(``?files`` forces the raw listing, ``?trace`` a trace.jsonl event
+viewer). ``/aggregate`` is the cross-run dashboard: a pass/fail matrix
+over workload × nemesis × db, per-run phase-breakdown bars from
+telemetry, and failure dedupe by checker verdict signature — the seed
+of the campaign summary page (ROADMAP direction 2).
 """
 
 from __future__ import annotations
@@ -17,7 +22,7 @@ from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import quote
 
 _CSS = """
-body{font-family:sans-serif;margin:2em;max-width:70em}
+body{font-family:sans-serif;margin:2em;max-width:75em}
 table{border-collapse:collapse}
 td,th{border:1px solid #ccc;padding:4px 8px;text-align:left}
 .ok{color:#2a2;font-weight:bold}
@@ -25,7 +30,17 @@ td,th{border:1px solid #ccc;padding:4px 8px;text-align:left}
 .unk{color:#b80;font-weight:bold}
 img{max-width:100%;border:1px solid #ddd;margin:4px 0}
 code{background:#f4f4f4;padding:1px 4px}
+.bar{display:inline-block;height:12px;vertical-align:middle}
+.barbox{display:inline-block;width:320px;background:#f4f4f4;
+    border:1px solid #ddd;font-size:0;line-height:0}
+.dim{color:#888}
 """
+
+#: run-phase display order and bar colors (phases map keys come from
+#: runner/telemetry.py's ``phase:<name>`` spans)
+_PHASES = (("setup", "#9ab8d8"), ("generate", "#8fc98f"),
+           ("teardown", "#d8d8d8"), ("check", "#e0a848"),
+           ("save", "#b8a0d0"))
 
 
 def _badge(v) -> str:
@@ -42,6 +57,17 @@ def _load_json(path: str):
         return None
 
 
+def _failure_signature(results: dict) -> str:
+    """Dedupe key for failing runs: the sorted set of
+    ``checker=verdict`` entries that are not clean passes."""
+    sig = []
+    for k, v in results.items():
+        if isinstance(v, dict) and "valid?" in v and \
+                v.get("valid?") is not True:
+            sig.append(f"{k}={v.get('valid?')}")
+    return ", ".join(sorted(sig))
+
+
 def _run_rows(store_base: str) -> list[dict]:
     from .forensics import all_runs
     rows = []
@@ -54,13 +80,43 @@ def _run_rows(store_base: str) -> list[dict]:
         except OSError:
             mtime = 0
         ops = (results.get("stats") or {}).get("count")
+        tel = results.get("telemetry") or {}
+        nem = test.get("nemesis_spec") or []
+        if isinstance(nem, (list, tuple)):
+            nem = ",".join(str(n) for n in nem)
         rows.append({"dir": rel, "mtime": mtime,
                      "valid?": results.get("valid?", "?"),
                      "name": test.get("name", rel.split(os.sep)[0]),
+                     "workload": test.get("workload", "?"),
+                     "nemesis": nem or "none",
+                     "db": test.get("db_mode") or "sim",
                      "time_limit": test.get("time_limit"),
-                     "ops": ops})
+                     "ops": ops,
+                     "phases": tel.get("phases") or {},
+                     "signature": _failure_signature(results)})
     rows.sort(key=lambda r: r["mtime"], reverse=True)
     return rows
+
+
+def _phase_bar(phases: dict) -> str:
+    """A stacked horizontal bar of the run's phase wall times."""
+    total = sum(v for v in phases.values()
+                if isinstance(v, (int, float)))
+    if not total:
+        return "<span class='dim'>no telemetry</span>"
+    segs = []
+    for name, color in _PHASES:
+        v = phases.get(name)
+        if not v:
+            continue
+        pct = 100.0 * v / total
+        segs.append(
+            f"<span class='bar' style='width:{pct:.2f}%;"
+            f"background:{color}' "
+            f"title='{html.escape(name)}: {v:.3f}s "
+            f"({pct:.0f}%)'></span>")
+    return (f"<span class='barbox'>{''.join(segs)}</span> "
+            f"<span class='dim'>{total:.2f}s</span>")
 
 
 def index_html(store_base: str) -> str:
@@ -77,9 +133,97 @@ def index_html(store_base: str) -> str:
     return (f"<!doctype html><title>jepsen_etcd_tpu store</title>"
             f"<style>{_CSS}</style>"
             "<h1>Test runs</h1>"
+            '<p><a href="/aggregate">cross-run dashboard &rarr;</a></p>'
             "<table><tr><th>run</th><th>time</th>"
             "<th>valid?</th><th>ops</th></tr>"
             + "".join(rows) + "</table>")
+
+
+def aggregate_html(store_base: str) -> str:
+    """The cross-run dashboard: pass/fail matrix over workload ×
+    (nemesis, db), per-run telemetry phase bars, and failure dedupe by
+    checker verdict signature."""
+    rows = _run_rows(store_base)
+    out = [f"<!doctype html><title>aggregate — jepsen_etcd_tpu</title>",
+           f"<style>{_CSS}</style>",
+           '<p><a href="/">&larr; all runs</a></p>',
+           f"<h1>Cross-run dashboard</h1>",
+           f"<p>{len(rows)} runs</p>"]
+
+    # -- pass/fail matrix: workload rows × (nemesis, db) columns -------------
+    cols = sorted({(r["nemesis"], r["db"]) for r in rows})
+    workloads = sorted({r["workload"] for r in rows}, key=str)
+    cells: dict = {}
+    for r in rows:
+        cells.setdefault(
+            (r["workload"], (r["nemesis"], r["db"])), []).append(r)
+    out.append("<h2>Pass/fail matrix</h2><table><tr><th>workload</th>")
+    out.extend(f"<th>{html.escape(str(n))}<br>"
+               f"<span class='dim'>{html.escape(str(d))}</span></th>"
+               for n, d in cols)
+    out.append("</tr>")
+    for w in workloads:
+        out.append(f"<tr><th>{html.escape(str(w))}</th>")
+        for c in cols:
+            runs = cells.get((w, c), [])
+            if not runs:
+                out.append("<td class='dim'>—</td>")
+                continue
+            npass = sum(1 for r in runs if r["valid?"] is True)
+            nfail = sum(1 for r in runs if r["valid?"] is False)
+            nunk = len(runs) - npass - nfail
+            bits = []
+            if npass:
+                bits.append(f"<span class='ok'>{npass}&nbsp;pass</span>")
+            if nfail:
+                bits.append(f"<span class='bad'>{nfail}&nbsp;fail</span>")
+            if nunk:
+                bits.append(f"<span class='unk'>{nunk}&nbsp;unk</span>")
+            links = " ".join(
+                f'<a href="/{quote(r["dir"])}/">'
+                f'{html.escape(os.path.basename(r["dir"]))}</a>'
+                for r in runs[:8])
+            out.append(f"<td>{' '.join(bits)}<br>"
+                       f"<span class='dim'>{links}</span></td>")
+        out.append("</tr>")
+    out.append("</table>")
+
+    # -- per-run phase breakdown bars ----------------------------------------
+    out.append("<h2>Phase breakdown (wall time per run)</h2>"
+               "<table><tr><th>run</th><th>valid?</th>"
+               "<th>phases</th></tr>")
+    for r in rows:
+        out.append(
+            f'<tr><td><a href="/{quote(r["dir"])}/">'
+            f'{html.escape(r["dir"])}</a></td>'
+            f"<td>{_badge(r['valid?'])}</td>"
+            f"<td>{_phase_bar(r['phases'])}</td></tr>")
+    out.append("</table><p class='dim'>"
+               + " ".join(f"<span class='bar' style='width:12px;"
+                          f"background:{c}'></span> {html.escape(n)}"
+                          for n, c in _PHASES) + "</p>")
+
+    # -- failure dedupe by verdict signature ---------------------------------
+    failing = [r for r in rows if r["valid?"] is not True]
+    out.append("<h2>Failure dedupe</h2>")
+    if not failing:
+        out.append("<p class='ok'>no failing runs</p>")
+    else:
+        groups: dict = {}
+        for r in failing:
+            groups.setdefault(r["signature"] or "(no checker verdict)",
+                              []).append(r)
+        out.append("<table><tr><th>verdict signature</th>"
+                   "<th>runs</th><th>dirs</th></tr>")
+        for sig, rs in sorted(groups.items(),
+                              key=lambda kv: -len(kv[1])):
+            links = " ".join(
+                f'<a href="/{quote(r["dir"])}/">'
+                f'{html.escape(r["dir"])}</a>' for r in rs[:12])
+            out.append(f"<tr><td><code>{html.escape(sig)}</code></td>"
+                       f"<td>{len(rs)}</td><td>{links}</td></tr>")
+        out.append("</table>")
+    return "".join(out)
 
 
 #: test.json keys shown in the run page's parameter table, in order
@@ -89,18 +233,84 @@ _PARAM_KEYS = ("workload", "nemesis_spec", "nemesis_interval",
                "unsafe_no_fsync", "corrupt_check", "version", "seed",
                "nodes")
 
+#: trace-viewer row cap per page load
+_TRACE_ROWS = 500
+
+
+def trace_html(store_base: str, rel: str, kind: str = "") -> str:
+    """The trace.jsonl event viewer: first ``_TRACE_ROWS`` events
+    (optionally filtered to one kind), with per-kind totals from the
+    run's results.json net-trace summary."""
+    rdir = os.path.join(store_base, rel)
+    results = _load_json(os.path.join(rdir, "results.json")) or {}
+    nt = results.get("net-trace") or {}
+    out = [f"<!doctype html><title>trace — {html.escape(rel)}</title>",
+           f"<style>{_CSS}</style>",
+           f'<p><a href="/{quote(rel)}/">&larr; run</a></p>',
+           f"<h1>trace — {html.escape(rel)}</h1>"]
+    counts = nt.get("counts") or {}
+    if counts:
+        out.append("<p>filter: "
+                   + " ".join(
+                       f'<a href="/{quote(rel)}/?trace={quote(k)}">'
+                       f"{html.escape(k)}</a>"
+                       f"&nbsp;<span class='dim'>({v})</span>"
+                       for k, v in sorted(counts.items()))
+                   + f' · <a href="/{quote(rel)}/?trace">all</a></p>')
+    if nt.get("dropped"):
+        out.append(f"<p class='bad'>{nt['dropped']} events dropped "
+                   "past the recorder cap</p>")
+    path = os.path.join(rdir, "trace.jsonl")
+    rows, shown, total = [], 0, 0
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if "kind" not in e:
+                    continue  # the trailing truncation marker
+                total += 1
+                if kind and e.get("kind") != kind:
+                    continue
+                if shown >= _TRACE_ROWS:
+                    continue
+                shown += 1
+                info = {k: v for k, v in e.items()
+                        if k not in ("t", "kind", "src", "dst")}
+                rows.append(
+                    f"<tr><td>{(e.get('t') or 0) / 1e9:.6f}</td>"
+                    f"<td>{html.escape(str(e.get('kind')))}</td>"
+                    f"<td>{html.escape(str(e.get('src')))}</td>"
+                    f"<td>{html.escape(str(e.get('dst')))}</td>"
+                    f"<td><code>{html.escape(json.dumps(info, default=repr)[:400])}"
+                    f"</code></td></tr>")
+    except OSError:
+        out.append("<p class='unk'>no trace.jsonl in this run "
+                   "(pass --tcpdump)</p>")
+        return "".join(out)
+    out.append(f"<p>{shown} of {total} events shown"
+               + (f" (kind <code>{html.escape(kind)}</code>)" if kind
+                  else "") + "</p>")
+    out.append("<table><tr><th>t (s)</th><th>kind</th><th>src</th>"
+               "<th>dst</th><th>info</th></tr>"
+               + "".join(rows) + "</table>")
+    return "".join(out)
+
 
 def run_html(store_base: str, rel: str) -> str:
     """The per-run report page (jepsen's run view: params, checker
-    verdicts, plots, artifacts)."""
+    verdicts, telemetry, plots, artifacts)."""
     rdir = os.path.join(store_base, rel)
     results = _load_json(os.path.join(rdir, "results.json")) or {}
     test = _load_json(os.path.join(rdir, "test.json")) or {}
     out = [f"<!doctype html><title>{html.escape(rel)}</title>",
            f"<style>{_CSS}</style>",
            f'<p><a href="/">&larr; all runs</a> &middot; '
+           f'<a href="/aggregate">dashboard</a> &middot; '
            f'<a href="/{quote(rel)}/?files">raw files</a></p>',
-           f"<h1>{html.escape(test.get('name', rel))} "
+           f"<h1>{html.escape(str(test.get('name', rel)))} "
            f"{_badge(results.get('valid?', '?'))}</h1>"]
     # parameters
     params = [(k, test[k]) for k in _PARAM_KEYS if k in test]
@@ -124,12 +334,56 @@ def run_html(store_base: str, rel: str) -> str:
                        f"<td>{_badge(v.get('valid?'))}</td>"
                        f"<td><code>{blob}</code></td></tr>")
         out.append("</table>")
+    # telemetry summary (phase bar, checker span totals, counters)
+    tel = results.get("telemetry") or {}
+    if tel:
+        out.append("<h2>Telemetry</h2>")
+        out.append(f"<p>{_phase_bar(tel.get('phases') or {})}</p>")
+        spans = tel.get("spans") or {}
+        if spans:
+            out.append("<table><tr><th>span</th><th>count</th>"
+                       "<th>total (s)</th></tr>")
+            for name, v in spans.items():
+                out.append(
+                    f"<tr><td><code>{html.escape(str(name))}</code></td>"
+                    f"<td>{v.get('count')}</td>"
+                    f"<td>{v.get('total_s', 0):.4f}</td></tr>")
+            out.append("</table>")
+        counters = tel.get("counters") or {}
+        if counters:
+            out.append("<p>"
+                       + " · ".join(
+                           f"<code>{html.escape(str(k))}</code>={v}"
+                           for k, v in sorted(counters.items()))
+                       + "</p>")
+        if tel.get("dropped"):
+            out.append(f"<p class='bad'>{tel['dropped']} telemetry "
+                       "records dropped past the cap</p>")
+    # net-trace summary
+    nt = results.get("net-trace") or {}
+    if nt:
+        out.append("<h2>Network trace</h2>"
+                   f"<p>{nt.get('events', 0)} events"
+                   + (f", <span class='bad'>{nt['dropped']} "
+                      "dropped</span>" if nt.get("dropped") else "")
+                   + (f' · <a href="/{quote(rel)}/?trace">'
+                      "event viewer</a>"
+                      if os.path.exists(os.path.join(rdir,
+                                                     "trace.jsonl"))
+                      else "") + "</p>")
+        if nt.get("counts"):
+            out.append("<p class='dim'>"
+                       + " · ".join(
+                           f"{html.escape(str(k))}: {v}"
+                           for k, v in sorted(nt["counts"].items()))
+                       + "</p>")
     # plots inline
     plots = [f for f in ("latency-raw.png", "rate.png", "clock.png")
              if os.path.exists(os.path.join(rdir, f))]
     if plots:
         out.append("<h2>Plots</h2>")
-        out.extend(f'<img src="/{quote(rel)}/{quote(f)}" alt="{f}">'
+        out.extend(f'<img src="/{quote(rel)}/{quote(f)}" '
+                   f'alt="{html.escape(f)}">'
                    for f in plots)
     # artifacts
     out.append("<h2>Artifacts</h2><ul>")
@@ -143,8 +397,9 @@ def run_html(store_base: str, rel: str) -> str:
 
 
 class StoreHandler(SimpleHTTPRequestHandler):
-    """Serves the store dir; '/' renders the run index, run dirs render
-    report pages (?files for the raw listing)."""
+    """Serves the store dir; '/' renders the run index, '/aggregate'
+    the cross-run dashboard, run dirs render report pages (?files for
+    the raw listing, ?trace for the trace.jsonl viewer)."""
 
     store_base = "store"
 
@@ -164,13 +419,19 @@ class StoreHandler(SimpleHTTPRequestHandler):
         path, _, query = self.path.partition("?")
         if path in ("/", "/index.html"):
             return self._html(index_html(self.store_base))
-        want_files = "files" in parse_qs(query, keep_blank_values=True)
-        if path.endswith("/") and not want_files:
+        if path in ("/aggregate", "/aggregate/"):
+            return self._html(aggregate_html(self.store_base))
+        qs = parse_qs(query, keep_blank_values=True)
+        if path.endswith("/") and "files" not in qs:
             rel = os.path.normpath(path.strip("/"))
             rdir = os.path.join(self.store_base, rel)
             # only render report pages for real run dirs inside the store
             if not rel.startswith("..") and \
                     os.path.exists(os.path.join(rdir, "results.json")):
+                if "trace" in qs:
+                    kind = (qs["trace"][0] or "").strip()
+                    return self._html(
+                        trace_html(self.store_base, rel, kind))
                 return self._html(run_html(self.store_base, rel))
         super().do_GET()
 

@@ -32,7 +32,7 @@ from __future__ import annotations
 from ..core.op import Op
 from ..client import with_errors
 from ..client import txn as t
-from ..checkers import compose, TimelineHtml
+from ..checkers import compose
 from ..checkers.tpu_linearizable import TPULinearizableChecker
 from ..checkers.set_full import SetFull
 from ..generators import mix
@@ -220,8 +220,9 @@ def workload(opts: dict) -> dict:
         "checker": compose({
             # mutex packs onto the TPU WGL kernel via the CAS-register
             # adapter (ops/wgl.py mutex_adapter); CPU oracle on fallback
+            # (the positioned timeline renders at the top of the stack,
+            # compose.py — full history, nemesis bands)
             "linear": TPULinearizableChecker(Mutex),
-            "timeline": TimelineHtml(),
         }),
         "generator": mix([acquires, releases]),
     }
@@ -240,7 +241,6 @@ def _set_like_workload(client) -> dict:
         "client": client,
         "checker": compose({
             "set": SetFull(linearizable=True),
-            "timeline": TimelineHtml(),
         }),
         "generator": mix([adds, reads]),
     }

@@ -15,7 +15,7 @@ from ..core.op import Op
 from ..client import with_errors
 from ..generators import independent, mix, reserve, limit
 from ..models import VersionedRegister
-from ..checkers import compose, independent_checker, TimelineHtml
+from ..checkers import compose, independent_checker
 from ..checkers.tpu_linearizable import TPULinearizableChecker
 from .base import WorkloadClient
 
@@ -75,9 +75,11 @@ def workload(opts: dict) -> dict:
         "client": RegisterClient(),
         "checker": independent_checker(compose({
             # TPU frontier-BFS kernel with sound CPU-oracle fallback
+            # (the positioned timeline renders at the top of the stack,
+            # compose.py — a per-key subhistory would lose the nemesis
+            # bands and clobber timeline.html once per key)
             "linear": TPULinearizableChecker(
                 lambda: VersionedRegister(0, None)),
-            "timeline": TimelineHtml(),
         })),
         "generator": independent.concurrent_generator(
             group,

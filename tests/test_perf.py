@@ -1,0 +1,91 @@
+"""checkers/perf.py unit coverage: quantile edge cases, latency-point
+completion pairing, and nemesis band extraction."""
+
+import pytest
+
+from jepsen_etcd_tpu.checkers.perf import (latency_points, nemesis_bands,
+                                           quantiles)
+from jepsen_etcd_tpu.core.history import History
+from jepsen_etcd_tpu.core.op import Op
+
+SECOND = 1_000_000_000
+
+
+def H(*ops):
+    return History([Op(o) for o in ops])
+
+
+def ev(typ, p, f, v, t_s):
+    return {"type": typ, "process": p, "f": f, "value": v,
+            "time": int(t_s * SECOND)}
+
+
+# ---- quantiles --------------------------------------------------------------
+
+def test_quantiles_empty():
+    assert quantiles([]) == {}
+
+
+def test_quantiles_single_sample():
+    # every quantile of one sample is that sample (1.0 must not
+    # index past the end)
+    assert quantiles([7.0]) == {0.5: 7.0, 0.95: 7.0, 0.99: 7.0,
+                                1.0: 7.0}
+
+
+def test_quantiles_orders_input():
+    q = quantiles([30.0, 10.0, 20.0, 40.0], qs=(0.5, 1.0))
+    assert q[0.5] == 30.0
+    assert q[1.0] == 40.0
+
+
+# ---- latency_points ---------------------------------------------------------
+
+def test_latency_points_pairs_completions():
+    h = H(ev("invoke", 0, "read", None, 1.0),
+          ev("ok", 0, "read", 3, 1.5),
+          ev("invoke", 1, "write", 9, 2.0),
+          ev("fail", 1, "write", 9, 2.25))
+    pts = latency_points(h)
+    assert set(pts) == {"read", "write"}
+    (t, lat, typ), = pts["read"]
+    assert t == pytest.approx(1.0)
+    assert lat == pytest.approx(500.0)  # ms
+    assert typ == "ok"
+    (t, lat, typ), = pts["write"]
+    assert lat == pytest.approx(250.0)
+    assert typ == "fail"
+
+
+def test_latency_points_skips_unpaired_and_nemesis():
+    h = H(ev("invoke", 0, "read", None, 1.0),       # never completes
+          ev("invoke", "nemesis", "kill", None, 1.5),
+          ev("info", "nemesis", "kill", None, 2.0),
+          ev("invoke", 1, "write", 4, 3.0),
+          ev("ok", 1, "write", 4, 3.5))
+    pts = latency_points(h)
+    assert set(pts) == {"write"}        # no open read, no nemesis ops
+    assert len(pts["write"]) == 1
+
+
+# ---- nemesis_bands ----------------------------------------------------------
+
+def test_nemesis_bands_extraction():
+    h = H(ev("invoke", 0, "read", None, 0.0),       # clients don't band
+          ev("invoke", "nemesis", "kill", None, 1.0),
+          ev("info", "nemesis", "kill", None, 3.0),
+          ev("invoke", "nemesis", "partition", None, 3.5),
+          ev("info", "nemesis", "partition", None, 5.0),
+          ev("ok", 0, "read", 1, 6.0))
+    bands = nemesis_bands(h)
+    assert bands == [
+        {"f": "kill", "start": pytest.approx(1.0),
+         "end": pytest.approx(3.0)},
+        {"f": "partition", "start": pytest.approx(3.5),
+         "end": pytest.approx(5.0)},
+    ]
+
+
+def test_nemesis_bands_unclosed_window_is_dropped():
+    h = H(ev("invoke", "nemesis", "kill", None, 1.0))
+    assert nemesis_bands(h) == []

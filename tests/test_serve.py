@@ -1,0 +1,161 @@
+"""HTTP smoke tests for serve.py over a hand-built fixture store:
+index badges and artifact links, the per-run report page (parameters,
+checkers, telemetry), the /aggregate cross-run dashboard (pass/fail
+matrix, phase bars, failure dedupe), the ?trace event viewer, and
+HTML escaping of run-controlled strings."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from jepsen_etcd_tpu.serve import make_server
+
+
+def mk_run(base, test_name, run_name, results, test, history="",
+           trace=None):
+    d = base / test_name / run_name
+    d.mkdir(parents=True)
+    # all_runs only lists dirs that hold a history.jsonl
+    (d / "history.jsonl").write_text(history)
+    (d / "results.json").write_text(json.dumps(results))
+    (d / "test.json").write_text(json.dumps(test))
+    if trace is not None:
+        (d / "trace.jsonl").write_text(
+            "".join(json.dumps(e) + "\n" for e in trace))
+    return d
+
+
+TELEMETRY = {
+    "schema": 1,
+    "spans": {"phase:check": {"count": 1, "total_s": 0.5},
+              "checker:workload": {"count": 1, "total_s": 0.4},
+              "wgl.check_packed": {"count": 3, "total_s": 0.3}},
+    "counters": {"engine.jnp-ladder": 3, "wgl.rungs": 7},
+    "phases": {"setup": 0.1, "generate": 1.2, "teardown": 0.05,
+               "check": 0.5},
+    "checkers": {"workload": 0.4},
+    "file": "telemetry.jsonl",
+}
+
+
+@pytest.fixture
+def store(tmp_path):
+    base = tmp_path / "store"
+    mk_run(base, "etcd-register", "00001",
+           {"valid?": True, "stats": {"valid?": True, "count": 120},
+            "workload": {"valid?": True},
+            "telemetry": TELEMETRY,
+            "net-trace": {"events": 2, "dropped": 0,
+                          "counts": {"send": 1, "deliver": 1}}},
+           {"name": "etcd-register", "workload": "register",
+            "nemesis_spec": [], "db_mode": "sim", "time_limit": 30,
+            "rate": 200.0, "nodes": ["n1", "n2"]},
+           trace=[{"t": 1_000_000, "kind": "send", "src": "n1",
+                   "dst": "n2", "msg": "append"},
+                  {"t": 2_000_000, "kind": "deliver", "src": "n2",
+                   "dst": "n1", "msg": "append"},
+                  {"truncated": 0}])
+    mk_run(base, "etcd-register-kill", "00001",
+           {"valid?": False, "stats": {"valid?": True, "count": 80},
+            "workload": {"valid?": False}},
+           {"name": "etcd-register-kill", "workload": "register",
+            "nemesis_spec": ["kill"], "db_mode": "sim"})
+    mk_run(base, "etcd-set-kill", "00001",
+           {"valid?": False, "stats": {"valid?": True, "count": 60},
+            "workload": {"valid?": False}},
+           {"name": "etcd-set-kill", "workload": "set",
+            "nemesis_spec": ["kill"], "db_mode": "sim"})
+    mk_run(base, "weird", "00001",
+           {"valid?": True, "stats": {"valid?": True, "count": 1}},
+           {"name": "x<b>run</b>", "workload": "none",
+            "nemesis_spec": [], "db_mode": "sim"})
+    return base
+
+
+@pytest.fixture
+def server(store):
+    srv = make_server(str(store), port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def get(url):
+    return urllib.request.urlopen(url, timeout=10).read().decode()
+
+
+def test_index(server):
+    page = get(server + "/")
+    assert 'class="ok">True' in page
+    assert 'class="bad">False' in page
+    assert 'href="/aggregate"' in page
+    assert "etcd-register/00001" in page
+    assert "<td>120</td>" in page      # op count column
+
+
+def test_run_page(server):
+    page = get(server + "/etcd-register/00001/")
+    assert "Parameters" in page and "Checkers" in page
+    # telemetry section: phase bar, span table, counters, file link
+    assert "Telemetry" in page
+    assert "class='barbox'" in page
+    assert "wgl.check_packed" in page and "<td>3</td>" in page
+    assert "engine.jnp-ladder</code>=3" in page
+    # net-trace summary links to the event viewer
+    assert "Network trace" in page and "2 events" in page
+    assert "?trace" in page
+    # artifact links
+    assert "results.json" in page and "history.jsonl" in page
+
+
+def test_aggregate_dashboard(server):
+    page = get(server + "/aggregate")
+    assert "Cross-run dashboard" in page and "4 runs" in page
+    # matrix: workload rows x (nemesis, db) columns with counts
+    assert "Pass/fail matrix" in page
+    assert "<th>register</th>" in page and "<th>set</th>" in page
+    assert "kill" in page
+    assert "1&nbsp;pass" in page and "1&nbsp;fail" in page
+    # phase breakdown bars from telemetry (and the no-telemetry dim)
+    assert "Phase breakdown" in page
+    assert "class='barbox'" in page
+    assert "no telemetry" in page
+    # failure dedupe: both kill runs share one verdict signature
+    assert "Failure dedupe" in page
+    assert "workload=False" in page
+    assert "<td>2</td>" in page
+
+
+def test_trace_viewer(server):
+    page = get(server + "/etcd-register/00001/?trace")
+    assert "2 of 2 events shown" in page
+    assert "<td>send</td>" in page and "<td>deliver</td>" in page
+    assert "n1" in page and "append" in page
+    # per-kind filter
+    page = get(server + "/etcd-register/00001/?trace=send")
+    assert "1 of 2 events shown" in page
+    assert "<td>send</td>" in page and "<td>deliver</td>" not in page
+    # a run without trace.jsonl degrades gracefully
+    page = get(server + "/weird/00001/?trace")
+    assert "no trace.jsonl" in page
+
+
+def test_escaping(server):
+    # run-controlled strings (test name) must never reach the page raw
+    page = get(server + "/weird/00001/")
+    assert "<b>run</b>" not in page
+    assert "x&lt;b&gt;run&lt;/b&gt;" in page
+
+
+def test_raw_files_still_served(server):
+    raw = get(server + "/etcd-register/00001/results.json")
+    assert json.loads(raw)["valid?"] is True
+    listing = get(server + "/etcd-register/00001/?files")
+    assert "Directory listing" in listing
+    assert "history.jsonl" in listing

@@ -1,0 +1,276 @@
+"""Run telemetry: recorder unit tests, file/summary reconciliation,
+end-to-end wiring through a sim run, the forced-kernel counter path,
+the --no-telemetry opt-out, and bench-cell schema equality."""
+
+import json
+import os
+
+import pytest
+
+from jepsen_etcd_tpu.runner import telemetry
+from jepsen_etcd_tpu.runner.telemetry import (
+    Telemetry, NullTelemetry, NULL, SPAN_FIELDS, COUNTER_FIELDS,
+    EVENT_FIELDS)
+
+
+def read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+class FakeClock:
+    """Deterministic monotonic clock: +0.25 s per reading."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 0.25
+        return self.t
+
+
+@pytest.fixture(autouse=True)
+def _isolate_current():
+    """No test may leak a process-current recorder."""
+    yield
+    telemetry.set_current(None)
+
+
+# ---- recorder unit tests ----------------------------------------------------
+
+def test_span_records_reconcile_with_summary(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tel = Telemetry(path, clock=FakeClock())
+    with tel.span("phase:check", ops=7):
+        with tel.span("wgl.check_packed", w=3) as sp:
+            sp.set(engine="jnp-ladder", rungs=2)
+        with tel.span("wgl.check_packed"):
+            pass
+    tel.counter("wgl.dispatches", 2)
+    tel.close()
+
+    recs = read_jsonl(path)
+    spans = [r for r in recs if r["kind"] == "span"]
+    counters = [r for r in recs if r["kind"] == "counter"]
+    assert all(tuple(r.keys()) == SPAN_FIELDS for r in spans)
+    assert all(tuple(r.keys()) == COUNTER_FIELDS for r in counters)
+
+    s = tel.summary()
+    assert s["schema"] == telemetry.SCHEMA_VERSION
+    assert s["file"] == "t.jsonl"
+    # every summary total is exactly the sum of the file's records
+    for name, agg in s["spans"].items():
+        mine = [r for r in spans if r["name"] == name]
+        assert len(mine) == agg["count"]
+        assert sum(r["dur_s"] for r in mine) == \
+            pytest.approx(agg["total_s"])
+    assert s["spans"]["wgl.check_packed"]["count"] == 2
+    assert s["phases"] == {"check": s["spans"]["phase:check"]["total_s"]}
+    # attrs set mid-span land in the file record
+    attrs = [r["attrs"] for r in spans if r["name"] == "wgl.check_packed"]
+    assert {"w": 3, "engine": "jnp-ladder", "rungs": 2} in attrs
+    # counters flush as records at close and match the summary
+    assert {r["name"]: r["value"] for r in counters} == s["counters"] \
+        == {"wgl.dispatches": 2}
+
+
+def test_counter_sum_and_max_modes():
+    tel = Telemetry()
+    tel.counter("n")
+    tel.counter("n", 4)
+    tel.counter("peak", 7, mode="max")
+    tel.counter("peak", 3, mode="max")
+    tel.counter("peak", 9, mode="max")
+    assert tel.summary()["counters"] == {"n": 5, "peak": 9}
+
+
+def test_null_outside_run():
+    assert isinstance(telemetry.current(), NullTelemetry)
+    assert telemetry.current() is NULL
+    assert NULL.enabled is False
+    with NULL.span("x", a=1) as sp:
+        sp.set(b=2)  # all no-ops
+    NULL.counter("c")
+    NULL.event("e")
+    assert NULL.summary() == {}
+
+
+def test_set_current_roundtrip():
+    tel = Telemetry()
+    telemetry.set_current(tel)
+    assert telemetry.current() is tel
+    telemetry.set_current(None)
+    assert telemetry.current() is NULL
+
+
+def test_max_records_drops_past_cap(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tel = Telemetry(path, clock=FakeClock(), max_records=3)
+    for _ in range(5):
+        with tel.span("s"):
+            pass
+    tel.close()
+    s = tel.summary()
+    assert s["dropped"] == 2
+    # aggregation still sees every span; only the file is capped
+    assert s["spans"]["s"]["count"] == 5
+    recs = read_jsonl(path)
+    assert sum(1 for r in recs if r["kind"] == "span") == 3
+    drop = [r for r in recs if r["kind"] == "event"
+            and r["name"] == "telemetry.dropped"]
+    assert drop and drop[0]["attrs"]["dropped"] == 2
+    assert tuple(drop[0].keys()) == EVENT_FIELDS
+
+
+def test_close_idempotent(tmp_path):
+    tel = Telemetry(str(tmp_path / "t.jsonl"))
+    with tel.span("s"):
+        pass
+    tel.close()
+    tel.close()  # must not raise or re-flush
+
+
+# ---- end-to-end: a sim run writes and reconciles telemetry ------------------
+
+def run(tmp_path, **opts):
+    from jepsen_etcd_tpu.compose import etcd_test
+    from jepsen_etcd_tpu.runner.test_runner import run_test
+    base = {"time_limit": 4, "rate": 50, "ops_per_key": 30,
+            "store_base": str(tmp_path), "seed": 11}
+    base.update(opts)
+    return run_test(etcd_test(base))
+
+
+def test_run_writes_telemetry_and_reconciles(tmp_path):
+    out = run(tmp_path, workload="register")
+    assert out["valid?"] is True
+    path = os.path.join(out["dir"], "telemetry.jsonl")
+    assert os.path.exists(path)
+
+    tel = out["results"]["telemetry"]
+    # ...and the summary persists into results.json on disk
+    with open(os.path.join(out["dir"], "results.json")) as f:
+        assert json.load(f)["telemetry"] == tel
+
+    # run phases (save closes after the summary snapshot, so it lives
+    # in the file only)
+    assert {"setup", "generate", "teardown", "check"} <= \
+        set(tel["phases"])
+    assert tel["phases"]["check"] > 0
+    # each composed checker contributed a span
+    assert {"perf", "stats", "workload", "crash"} <= set(tel["checkers"])
+
+    # the file's span records sum to exactly the summary totals
+    recs = read_jsonl(path)
+    for r in recs:
+        want = {"span": SPAN_FIELDS, "counter": COUNTER_FIELDS,
+                "event": EVENT_FIELDS}[r["kind"]]
+        assert tuple(r.keys()) == want
+    by_name = {}
+    for r in recs:
+        if r["kind"] == "span":
+            agg = by_name.setdefault(r["name"], [0, 0.0])
+            agg[0] += 1
+            agg[1] += r["dur_s"]
+    for name, v in tel["spans"].items():
+        assert by_name[name][0] == v["count"], name
+        assert by_name[name][1] == pytest.approx(v["total_s"]), name
+    assert "phase:save" in by_name  # file-only, see above
+
+    # register's small per-key subhistories route to the CPU oracle
+    assert tel["counters"].get("engine.cpu-oracle", 0) >= 1
+    file_counters = {r["name"]: r["value"] for r in recs
+                     if r["kind"] == "counter"}
+    assert file_counters == tel["counters"]
+
+    # the recorder is uninstalled after the run
+    assert telemetry.current() is NULL
+
+
+def test_no_telemetry_opt_out(tmp_path):
+    out = run(tmp_path, workload="register", no_telemetry=True)
+    assert out["valid?"] is True
+    assert not os.path.exists(os.path.join(out["dir"], "telemetry.jsonl"))
+    assert "telemetry" not in out["results"]
+    with open(os.path.join(out["dir"], "results.json")) as f:
+        assert "telemetry" not in json.load(f)
+
+
+# ---- forced kernel path: TPU counters under JAX_PLATFORMS=cpu ---------------
+
+def test_forced_kernel_emits_tpu_counters(tmp_path):
+    """cpu_cutoff=None pins the wave-kernel path (the jnp ladder on
+    this CPU host), which must emit the ISSUE's TPU-path telemetry:
+    engine counter, dispatch count, rung count, max frontier width,
+    pack + dispatch spans with wall times."""
+    from jepsen_etcd_tpu.checkers.tpu_linearizable import \
+        TPULinearizableChecker
+    from jepsen_etcd_tpu.core.history import History
+    from jepsen_etcd_tpu.core.op import Op
+    from jepsen_etcd_tpu.models import VersionedRegister
+
+    ops, t = [], 0
+    for i in range(20):
+        ops.append(Op({"type": "invoke", "process": 0, "f": "write",
+                       "value": [None, i], "time": t}))
+        ops.append(Op({"type": "ok", "process": 0, "f": "write",
+                       "value": [i + 1, i], "time": t + 1}))
+        t += 2
+    h = History(ops)
+
+    tel = Telemetry(str(tmp_path / "t.jsonl"))
+    telemetry.set_current(tel)
+    try:
+        checker = TPULinearizableChecker(
+            lambda: VersionedRegister(0, None), cpu_cutoff=None)
+        res = checker.check({}, h)
+    finally:
+        telemetry.set_current(None)
+        tel.close()
+
+    assert res["valid?"] is True
+    s = tel.summary()
+    assert s["counters"].get("engine.jnp-ladder") == 1
+    assert s["counters"].get("wgl.dispatches") == 1
+    assert s["counters"].get("wgl.rungs", 0) >= 1
+    assert s["counters"].get("wgl.max-frontier", 0) >= 1
+    assert s["spans"]["wgl.pack"]["count"] == 1
+    assert s["spans"]["wgl.check_packed"]["count"] == 1
+    assert s["spans"]["wgl.check_packed"]["total_s"] > 0
+    # the dispatch span carries the engine + rung attrs in the file
+    recs = read_jsonl(str(tmp_path / "t.jsonl"))
+    disp = [r for r in recs if r["kind"] == "span"
+            and r["name"] == "wgl.check_packed"]
+    assert disp[0]["attrs"]["engine"] == "jnp-ladder"
+    assert disp[0]["attrs"]["valid"] is True
+
+
+# ---- bench cells share the run span schema ----------------------------------
+
+def test_bench_cell_schema_equals_run_schema(tmp_path):
+    import bench
+
+    # a bench cell span, recorded exactly as bench.py main() does
+    bench_path = str(tmp_path / "bench.jsonl")
+    tel = Telemetry(bench_path, clock=FakeClock())
+    out = bench._run_cell(tel, "demo", lambda: {"ok": True, "n": 3,
+                                                "skip": [1, 2]})
+    tel.close()
+    assert out["ok"] is True
+    cell = read_jsonl(bench_path)[0]
+    assert cell["kind"] == "span" and cell["name"] == "cell:demo"
+    # scalar result fields become span attrs; non-scalars are dropped
+    assert cell["attrs"] == {"ok": True, "n": 3}
+
+    # a run-style span from the same recorder class
+    run_path = str(tmp_path / "run.jsonl")
+    tel2 = Telemetry(run_path, clock=FakeClock())
+    with tel2.span("phase:check", ops=1):
+        pass
+    tel2.close()
+    run_rec = read_jsonl(run_path)[0]
+
+    # schema equality: identical field sets, identical order, both
+    # matching the pinned schema
+    assert tuple(cell.keys()) == tuple(run_rec.keys()) == SPAN_FIELDS
+    assert bench._bench_telemetry is not None  # bench wires a recorder

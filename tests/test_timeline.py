@@ -1,0 +1,140 @@
+"""Positioned timeline rendering (VERDICT #7): per-process columns,
+ops as absolutely positioned boxes spanning invoke→complete, nemesis
+bands, hover detail, escaping — plus the acceptance run: a sim lock
+test under kill faults renders overlapping boxes and fault bands."""
+
+import os
+import re
+
+from jepsen_etcd_tpu.checkers.timeline import TimelineHtml
+from jepsen_etcd_tpu.core.history import History
+from jepsen_etcd_tpu.core.op import Op
+
+SECOND = 1_000_000_000
+
+
+def H(*ops):
+    return History([Op(o) for o in ops])
+
+
+def ev(typ, p, f, v, t_s):
+    return {"type": typ, "process": p, "f": f, "value": v,
+            "time": int(t_s * SECOND)}
+
+
+def overlapping_history():
+    return H(
+        ev("invoke", 0, "write", 1, 0.0),
+        ev("invoke", "nemesis", "kill", None, 0.5),
+        ev("invoke", 1, "read", None, 1.0),
+        ev("invoke", 2, "write", "<x>", 1.5),   # never completes
+        ev("ok", 0, "write", 1, 2.0),
+        ev("info", "nemesis", "kill", None, 2.5),
+        ev("ok", 1, "read", 1, 3.0),
+    )
+
+
+def boxes(doc):
+    """[(left_px, top_px, height_px, is_open)] for every op box."""
+    out = []
+    for m in re.finditer(
+            r"class='op( open)?' style='left:(\d+)px;top:(\d+)px;"
+            r"height:(\d+)px", doc):
+        out.append((int(m.group(2)), int(m.group(3)), int(m.group(4)),
+                    bool(m.group(1))))
+    return out
+
+
+def test_positioned_boxes_and_overlap():
+    doc = TimelineHtml().render({"name": "t"}, overlapping_history())
+    bs = boxes(doc)
+    assert len(bs) == 3
+    # three per-process columns, distinct x positions
+    assert doc.count("class='colhead'") == 3
+    lefts = {b[0] for b in bs}
+    assert len(lefts) == 3
+    # ops on p0 [0,2] and p1 [1,3] overlap in time: their vertical
+    # extents must intersect while sitting in different columns
+    (l0, t0, h0, _), (l1, t1, h1, _) = bs[0], bs[1]
+    assert l0 != l1
+    assert t0 < t1 + h1 and t1 < t0 + h0
+    # duration maps to height: the 2 s ops are visibly long
+    assert h0 > 10 and h1 > 10
+
+
+def test_open_op_rendered_dashed_to_end():
+    doc = TimelineHtml().render({"name": "t"}, overlapping_history())
+    bs = boxes(doc)
+    open_boxes = [b for b in bs if b[3]]
+    assert len(open_boxes) == 1
+    # the open op extends from its invoke (1.5 s) to t_max (3 s):
+    # at least as tall as half of a completed 2 s op
+    assert open_boxes[0][2] >= bs[0][2] // 2
+    assert "never completed" in doc
+
+
+def test_nemesis_band_and_hover_detail():
+    doc = TimelineHtml(nemesis_perf=[
+        {"name": "kills", "color": "#E9A4A4", "fs": ["kill"]},
+    ]).render({"name": "t"}, overlapping_history())
+    band = re.search(r"class='band' style='top:(\d+)px;"
+                     r"height:(\d+)px;background:(#\w+)'", doc)
+    assert band, "nemesis band missing"
+    assert band.group(3) == "#E9A4A4"  # the package's perf color
+    assert int(band.group(2)) > 10     # the 2 s window has real height
+    assert "class='bandlabel'" in doc and ">kill</div>" in doc
+    # hover titles carry the op detail
+    assert "process 0" in doc
+    assert re.search(r"title='[^']*2\.0000s\] ok \(2000\.0 ms\)", doc)
+
+
+def test_axis_ticks_and_meta():
+    doc = TimelineHtml().render({"name": "t"}, overlapping_history())
+    assert doc.count("class='tick'") >= 4
+    assert doc.count("class='grid'") >= 4
+    assert "3 ops" in doc and "3 processes" in doc
+
+
+def test_html_escaping():
+    h = H(ev("invoke", 0, "write", "<x>", 0.0),
+          ev("ok", 0, "write", "<x>", 1.0))
+    doc = TimelineHtml().render(
+        {"name": "<script>alert(1)</script>"}, h)
+    assert "<script>" not in doc
+    assert "&lt;script&gt;" in doc
+    assert "<x>" not in doc          # op value escaped in label+title
+    assert "&lt;x&gt;" in doc
+
+
+def test_check_writes_file(tmp_path):
+    res = TimelineHtml().check({"name": "t"}, overlapping_history(),
+                               {"store_dir": str(tmp_path)})
+    assert res["valid?"] is True
+    assert os.path.exists(res["file"])
+    with open(res["file"]) as f:
+        assert "class='op'" in f.read()
+    # no store dir -> valid, no file
+    assert TimelineHtml().check({}, overlapping_history()) == \
+        {"valid?": True}
+
+
+def test_sim_lock_run_timeline(tmp_path):
+    """Acceptance: a lock run under kill faults produces a timeline
+    whose blocked acquires are positioned boxes and whose fault
+    windows render as bands."""
+    from jepsen_etcd_tpu.compose import etcd_test
+    from jepsen_etcd_tpu.runner.test_runner import run_test
+    out = run_test(etcd_test({
+        "workload": "lock", "nemesis": ["kill"], "nemesis_interval": 2.0,
+        "time_limit": 6, "rate": 30, "store_base": str(tmp_path),
+        "seed": 3}))
+    path = os.path.join(out["dir"], "timeline.html")
+    assert os.path.exists(path)
+    with open(path) as f:
+        doc = f.read()
+    bs = boxes(doc)
+    assert len(bs) >= 4
+    assert len({b[0] for b in bs}) >= 2      # multiple process columns
+    assert len({b[1] for b in bs}) >= 2      # spread over the time axis
+    assert "class='band'" in doc             # kill windows
+    assert "acquire" in doc
