@@ -686,12 +686,86 @@ def bench_watch():
             "vs_baseline": round(BASELINE_SECONDS / max(dt, 1e-9), 1)}
 
 
+def _bucket_gen_profile(prof, n_events):
+    """Bucket a cProfile of a generation run into the hot-loop cost
+    centers the r6 overhaul targets: timer churn (SimLoop heap ops),
+    queue hops (Queue/Future/Task scheduling + interpreter dispatch),
+    generator poll, record (history append + SoA column emission), sut
+    (raft/client work — off-limits to optimisation, it defines history
+    timing), other. Returns {bucket: {s, us_per_op}}."""
+    import pstats
+    TIMER_FNS = {"call_later", "call_at", "cancel", "_compact",
+                 "sleep", "run", "time"}
+    buckets = dict.fromkeys(
+        ("timer_churn", "queue_hops", "generator_poll", "record",
+         "sut", "other"), 0.0)
+    for (fname, _ln, func), (_cc, _nc, tt, _ct, _callers) in \
+            pstats.Stats(prof).stats.items():
+        f = fname.replace("\\", "/")
+        if "/generators/" in f:
+            b = "generator_poll"
+        elif f.endswith("core/history.py") or (
+                f.endswith("runner/interpreter.py")
+                and func in ("record", "ctx")):
+            b = "record"
+        elif f.endswith(("runner/sim.py", "runner/wall.py")):
+            b = "timer_churn" if func in TIMER_FNS else "queue_hops"
+        elif f.endswith("runner/interpreter.py"):
+            b = "queue_hops"
+        elif "/sut/" in f or "/client/" in f or "/nemesis/" in f:
+            b = "sut"
+        else:
+            b = "other"
+        buckets[b] += tt
+    return {k: {"s": round(v, 3),
+                "us_per_op": round(1e6 * v / max(n_events, 1), 2)}
+            for k, v in buckets.items()}
+
+
+#: seed generation rate (events/s) this cell's vs_baseline divides by —
+#: the pre-overhaul hot loop measured ~6.8k events/s on this host (the
+#: register_50k cell generated ~135k events in 19.8 s; PERF.md §gen)
+SEED_GEN_OPS_PER_S = 6_800.0
+
+
+def bench_gen_throughput():
+    """Generation-throughput cell (r6): raw simulated history
+    production in events/s, plus a per-op µs cost breakdown from a
+    second, smaller profiled run (cProfile inflates wall time ~2x, so
+    the headline rate comes from the unprofiled leg)."""
+    import cProfile
+    _, gen_s, total = _sim_keys([0], 27_000, CONCURRENCY, 23,
+                                "bench-gen-throughput",
+                                nodes=["n1", "n2", "n3"])
+    rate = total / max(gen_s, 1e-9)
+    note(f"gen-throughput: {total} events in {gen_s:.2f}s "
+         f"({rate:,.0f} events/s)")
+    prof = cProfile.Profile()
+    prof.enable()
+    _, prof_s, prof_total = _sim_keys([0], 6_750, CONCURRENCY, 23,
+                                      "bench-gen-prof",
+                                      nodes=["n1", "n2", "n3"])
+    prof.disable()
+    breakdown = _bucket_gen_profile(prof, prof_total)
+    top = sorted(breakdown.items(), key=lambda kv: -kv[1]["s"])[:3]
+    note("gen-throughput profile: " + " ".join(
+        f"{k}={v['us_per_op']}us/op" for k, v in top))
+    return {"value": round(rate, 1), "unit": "events/s",
+            "gen_s": round(gen_s, 2), "events": total,
+            "per_op_us": round(1e6 * gen_s / max(total, 1), 2),
+            "profiled": {"events": prof_total,
+                         "wall_s": round(prof_s, 2),
+                         "breakdown": breakdown},
+            "vs_baseline": round(rate / SEED_GEN_OPS_PER_S, 2)}
+
+
 CELLS = [("register_100", bench_register_100),
          ("engine_crossover", bench_engine_crossover),
          ("deep_wgl_4n_2000", bench_deep_wgl),
          ("w128_deep", bench_w128_deep),
          ("faulted_register", bench_faulted_register),
          ("batched_64_keys", bench_batched_keys),
+         ("gen_throughput", bench_gen_throughput),
          ("register_50k", bench_register_50k),
          ("batched_512_keys", bench_batched_512_keys),
          ("set_full", bench_set),
@@ -813,6 +887,31 @@ def _dry_closure():
             "cycles": int(oc_np.any(axis=-1).sum())}
 
 
+def _dry_gen_throughput():
+    """Tiny sim through the full run path: the recorded history carries
+    SoA columns matching the dict stream event-for-event, and the
+    profile bucketing covers every cost center (structure only — no
+    timing asserts, CPU-safe)."""
+    import cProfile
+    from jepsen_etcd_tpu.core.history import History
+    prof = cProfile.Profile()
+    prof.enable()
+    test, out, _ = run_workload("register", time_limit=3, rate=100,
+                                seed=_DRY_SEED)
+    prof.disable()
+    h = out["history"]
+    cols = getattr(h, "columns", None)
+    assert cols is not None, "recorded history lost its columns"
+    assert len(cols) == len(h), (len(cols), len(h))
+    assert [dict(o) for o in History.from_columns(cols).ops] == \
+        [dict(o) for o in h.ops], "columns diverge from dict stream"
+    bk = _bucket_gen_profile(prof, len(h))
+    assert set(bk) == {"timer_churn", "queue_hops", "generator_poll",
+                       "record", "sut", "other"}, bk
+    assert bk["generator_poll"]["s"] > 0 and bk["sut"]["s"] > 0, bk
+    return {"ops": len(h), "events": len(cols)}
+
+
 def _dry_watch():
     """Tiny watch workload through the real checker."""
     from jepsen_etcd_tpu.checkers.watch import WatchChecker
@@ -828,6 +927,7 @@ DRY_CHECKS = {"register_100": _dry_register,
               "w128_deep": _dry_register,
               "faulted_register": _dry_register,
               "register_50k": _dry_register,
+              "gen_throughput": _dry_gen_throughput,
               "batched_64_keys": _dry_batched,
               "batched_512_keys": _dry_batched,
               "set_full": _dry_set,

@@ -11,7 +11,6 @@ padded tensor and vmap over it (SURVEY §2.3).
 from __future__ import annotations
 
 from ..core.history import History
-from ..generators.independent import subhistories
 from .core import Checker, _merge_valid
 
 
@@ -24,8 +23,12 @@ class Independent(Checker):
         # one pass over the parent history builds every per-key
         # subhistory (the per-key subhistory() loop re-scans the full
         # history once per key — O(K * N) host time the batched packer
-        # axis can't afford)
-        subs = {k: History(ops) for k, ops in subhistories(h).items()}
+        # axis can't afford). Recorded histories carry SoA columns, so
+        # the split is a grouped array slice and the per-key histories
+        # stay column-backed all the way into the batched packer — no
+        # per-op dict access on this path (guarded by the
+        # dict_materializations test in tests/test_history.py).
+        subs = h.split_by_key()
         if hasattr(self.inner, "check_batch"):
             # batch-aware inner checker (TPULinearizableChecker): one
             # vmapped kernel launch over the whole key batch, sharded

@@ -12,7 +12,7 @@ import os
 from collections import defaultdict
 from typing import Optional
 
-from ..core.history import History
+from ..core.history import History, TYPE_NAMES
 from ..core.op import Op
 from .core import Checker
 
@@ -20,8 +20,26 @@ SECOND = 1_000_000_000
 
 
 def latency_points(h: History) -> dict[str, list[tuple[float, float, str]]]:
-    """f -> [(invoke_time_s, latency_ms, completion_type)]."""
-    out: dict = defaultdict(list)
+    """f -> [(invoke_time_s, latency_ms, completion_type)].
+
+    Recorded histories carry SoA columns (core/history.py OpColumns):
+    invoke/completion pairing and the per-point fields come straight
+    from the typed arrays, no per-op dict access."""
+    cols = getattr(h, "columns", None)
+    if cols is not None:
+        out: dict = defaultdict(list)
+        tm = cols.time.tolist()
+        tc = cols.type_code.tolist()
+        fcl = cols.f_code.tolist()
+        ft = cols.f_table
+        for inv, comp in cols.client_pairs():
+            if comp < 0:
+                continue
+            out[ft[fcl[inv]]].append((tm[inv] / SECOND,
+                                      (tm[comp] - tm[inv]) / 1e6,
+                                      TYPE_NAMES[tc[comp]]))
+        return dict(out)
+    out = defaultdict(list)
     for op in h.client_ops():
         if not op.is_invoke:
             continue
@@ -69,7 +87,12 @@ class Perf(Checker):
                 "count": len(rows),
                 "ok-latency-ms": quantiles(oks),
             }
-        duration = (max((op["time"] for op in h), default=0) or 1) / SECOND
+        cols = getattr(h, "columns", None)
+        if cols is not None and len(cols):
+            duration = (int(cols.time.max()) or 1) / SECOND
+        else:
+            duration = (max((op["time"] for op in h),
+                            default=0) or 1) / SECOND
         rate = sum(len(r) for r in pts.values()) / max(duration, 1e-9)
         result = {"valid?": True, "latencies": stats,
                   "throughput-ops-per-s": rate,

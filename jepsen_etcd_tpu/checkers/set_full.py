@@ -37,7 +37,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ..core.history import History
+from ..core.history import History, TYPE_NAMES
 from .core import Checker
 
 
@@ -245,30 +245,10 @@ def _analyze_reference(h: History) -> dict:
     }
 
 
-def _analyze_columnar(h: History) -> dict:
-    """Vectorized analyze(): element x read presence matrix in numpy.
-
-    The host floor for set histories is the read payload: ~24k ops
-    carry ~15M observed values, and converting (or even type-checking)
-    every one costs more than the whole analysis budget. The pipeline
-    dodges the floor structurally: a growing set means consecutive
-    views share their prefix (compared by C-level list ==, which
-    short-circuits and compares shared int objects by identity) or
-    differ by a few insertions (_increment_of), so only arrival events
-    — new elements — are ever converted; runs of identical views
-    collapse into one presence row. Known points come from a reversed
-    first-arrival scatter, coverage from one broadcast compare of
-    known indices against invoke indices, and presence from a single
-    running-max fill over the row axis.
-
-    Exactness contract with the sweep: element values must be plain
-    ints (floats/Decimals/ad-hoc objects raise _NonColumnar and take
-    the sweep; bools alias their int values exactly as Python == does
-    in the sweep's set arithmetic). Histories the fast algebra cannot
-    express exactly — duplicate observations, reads that miss covered
-    elements, out-of-order ok indices — retry in full mode with one
-    row per read, which is bit-identical to the sweep by the
-    differential fuzz in tests/test_set.py."""
+def _scan_ops(h: History):
+    """Event scan over dict ops: adds + read views with chain/increment
+    compression (see _analyze_columnar's docstring). Returns
+    (adds, r_ri, r_rt, r_ok, views, payloads, anchor, mono)."""
     adds: dict = {}    # x -> [add_invoke, add_type, first_ok_idx, ok_time]
     r_ri: list = []          # read invoke index
     r_rt: list = []          # read invoke time
@@ -329,6 +309,118 @@ def _analyze_columnar(h: History) -> dict:
             r_ri.append(inv["index"] if inv is not None else oki)
             r_rt.append((inv if inv is not None else op).get("time") or 0)
             r_ok.append(oki)
+    return adds, r_ri, r_rt, r_ok, views, payloads, anchor, mono
+
+
+def _scan_columns(cols):
+    """_scan_ops over SoA columns (core/history.py OpColumns): the same
+    event scan fed from typed arrays and intern tables — no per-op dict
+    access, and read invocations pair by an inline per-process walk
+    instead of History.pairs (which would materialize dict ops on a
+    column-only history)."""
+    adds: dict = {}
+    r_ri: list = []
+    r_rt: list = []
+    r_ok: list = []
+    views: list = []
+    payloads: list = []
+    anchor: list = []
+    prev: list = []
+    mono = True
+    last_ok = None
+    tc = cols.type_code.tolist()
+    pr = cols.proc.tolist()
+    fcl = cols.f_code.tolist()
+    ft = cols.f_table
+    idx = cols.index.tolist()
+    tm = cols.time.tolist()
+    vals_col = cols.values
+    pt = cols.proc_table
+    open_by: dict = {}       # process code -> invoke row
+    for i, t in enumerate(tc):
+        p = pr[i]
+        if t == 0:
+            open_by[p] = i
+            inv_row = None
+        else:
+            inv_row = open_by.pop(p, None)
+        f = ft[fcl[i]]
+        if f == "add":
+            if p < 0 and not isinstance(pt[-1 - p], int):
+                continue
+            x = vals_col[i]
+            if type(x) is not int:
+                raise _NonColumnar
+            rec = adds.get(x)
+            if rec is None:
+                rec = adds[x] = [None, None, None, 0]
+            if t == 0:
+                rec[0] = idx[i]
+            else:
+                rec[1] = TYPE_NAMES[t]
+                if t == 1 and rec[2] is None:
+                    rec[2] = idx[i]        # first :ok completion
+                    rec[3] = tm[i] or 0
+        elif f == "read" and t == 1:
+            v = vals_col[i]
+            if v is None or (p < 0 and not isinstance(pt[-1 - p], int)):
+                continue
+            vals = v if type(v) is list else list(v)
+            lp = len(prev)
+            if views and len(vals) >= lp and vals[:lp] == prev:
+                payloads.append(vals[lp:])
+                anchor.append(False)
+            else:
+                inc = _increment_of(prev, vals) if views else None
+                if inc is not None:
+                    payloads.append(inc)
+                    anchor.append(False)
+                else:
+                    payloads.append(vals)
+                    anchor.append(True)
+            prev = vals
+            views.append(vals)
+            oki = idx[i]
+            if last_ok is not None and oki < last_ok:
+                mono = False
+            last_ok = oki
+            r_ri.append(idx[inv_row] if inv_row is not None else oki)
+            r_rt.append((tm[inv_row] if inv_row is not None
+                         else tm[i]) or 0)
+            r_ok.append(oki)
+    return adds, r_ri, r_rt, r_ok, views, payloads, anchor, mono
+
+
+def _analyze_columnar(h: History) -> dict:
+    """Vectorized analyze(): element x read presence matrix in numpy.
+
+    The host floor for set histories is the read payload: ~24k ops
+    carry ~15M observed values, and converting (or even type-checking)
+    every one costs more than the whole analysis budget. The pipeline
+    dodges the floor structurally: a growing set means consecutive
+    views share their prefix (compared by C-level list ==, which
+    short-circuits and compares shared int objects by identity) or
+    differ by a few insertions (_increment_of), so only arrival events
+    — new elements — are ever converted; runs of identical views
+    collapse into one presence row. Known points come from a reversed
+    first-arrival scatter, coverage from one broadcast compare of
+    known indices against invoke indices, and presence from a single
+    running-max fill over the row axis.
+
+    Exactness contract with the sweep: element values must be plain
+    ints (floats/Decimals/ad-hoc objects raise _NonColumnar and take
+    the sweep; bools alias their int values exactly as Python == does
+    in the sweep's set arithmetic). Histories the fast algebra cannot
+    express exactly — duplicate observations, reads that miss covered
+    elements, out-of-order ok indices — retry in full mode with one
+    row per read, which is bit-identical to the sweep by the
+    differential fuzz in tests/test_set.py."""
+    cols = getattr(h, "columns", None)
+    if cols is not None:
+        scan = _scan_columns(cols)
+    else:
+        scan = _scan_ops(h)
+    adds, r_ri, r_rt, r_ok, views, payloads, anchor, mono = scan
     nR = len(r_ok)
 
     def _to_i64(vals: list) -> np.ndarray:

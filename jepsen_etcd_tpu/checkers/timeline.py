@@ -96,15 +96,62 @@ class TimelineHtml(Checker):
             f.write(doc)
         return {"valid?": True, "file": path}
 
+    def _box_rows(self, h: History) -> list[tuple]:
+        """One row per client invoke, in invoke order:
+        ``(process, f, value, t0_ns, t1_ns|None, typ|None, error)`` —
+        value/typ from the completion when one exists (the completion's
+        view of the op is what the reference timeline shows). Columnar
+        when the history carries SoA columns: pairing and every field
+        come from the typed arrays, no per-op dict access."""
+        cols = getattr(h, "columns", None)
+        if cols is not None:
+            from ..core.history import TYPE_NAMES
+            tm = cols.time.tolist()
+            tc = cols.type_code.tolist()
+            fcl = cols.f_code.tolist()
+            ft = cols.f_table
+            ex = cols.extras
+            rows = []
+            for inv, comp in cols.client_pairs():
+                f = ft[fcl[inv]]
+                p = cols.process_at(inv)
+                if comp >= 0:
+                    err = (ex.get(comp) or {}).get("error")
+                    rows.append((p, f, cols.value_at(comp), tm[inv],
+                                 tm[comp], TYPE_NAMES[tc[comp]], err))
+                else:
+                    rows.append((p, f, cols.value_at(inv), tm[inv],
+                                 None, None, None))
+            return rows
+        rows = []
+        for op in h.client_ops():
+            if not op.is_invoke:
+                continue
+            comp = h.completion(op)
+            if comp is not None:
+                rows.append((op["process"], op.f, comp.get("value"),
+                             op["time"], comp["time"], comp["type"],
+                             comp.get("error")))
+            else:
+                rows.append((op["process"], op.f, op.get("value"),
+                             op["time"], None, None, None))
+        return rows
+
     def render(self, test, h: History) -> str:
-        ops = [op for op in h.client_ops() if op.is_invoke]
-        truncated = max(0, len(ops) - MAX_OPS)
-        ops = ops[:MAX_OPS]
+        boxes = self._box_rows(h)
+        truncated = max(0, len(boxes) - MAX_OPS)
+        boxes = boxes[:MAX_OPS]
         bands = nemesis_bands(h)
 
-        times = [op["time"] for op in h if op.get("time") is not None]
-        t_min = (min(times) if times else 0) / SECOND
-        t_max = (max(times) if times else 0) / SECOND
+        cols = getattr(h, "columns", None)
+        if cols is not None:
+            t_min = (int(cols.time.min()) if len(cols) else 0) / SECOND
+            t_max = (int(cols.time.max()) if len(cols) else 0) / SECOND
+        else:
+            times = [op["time"] for op in h
+                     if op.get("time") is not None]
+            t_min = (min(times) if times else 0) / SECOND
+            t_max = (max(times) if times else 0) / SECOND
         duration = max(t_max - t_min, 1e-9)
         px_per_s = min(MAX_PX_PER_S,
                        max(MIN_PX_PER_S, TARGET_HEIGHT_PX / duration))
@@ -113,7 +160,7 @@ class TimelineHtml(Checker):
         def y(ts: float) -> int:
             return HEAD_H + int((ts - t_min) * px_per_s)
 
-        processes = sorted({op["process"] for op in ops}, key=str)
+        processes = sorted({b[0] for b in boxes}, key=str)
         col_x = {p: AXIS_W + i * COL_W for i, p in enumerate(processes)}
         width = AXIS_W + max(1, len(processes)) * COL_W
 
@@ -143,25 +190,24 @@ class TimelineHtml(Checker):
                 f"<div class='colhead' style='left:{col_x[p]}px'>"
                 f"{html.escape(str(p))}</div>")
         # op boxes
-        for op in ops:
-            comp = h.completion(op)
-            t0 = op["time"] / SECOND
-            t1 = comp["time"] / SECOND if comp else t_max
-            typ = comp["type"] if comp else "info"
-            val = comp.get("value") if comp else op.get("value")
+        for p, f, val, t0n, t1n, typc, err in boxes:
+            done = t1n is not None
+            t0 = t0n / SECOND
+            t1 = t1n / SECOND if done else t_max
+            typ = typc if done else "info"
             top = y(t0)
             hgt = max(MIN_BOX_PX, y(t1) - top)
-            title = (f"process {op['process']} · {op.f} "
+            title = (f"process {p} · {f} "
                      f"{val!r}\n[{t0:.4f}s → "
                      + (f"{t1:.4f}s] {typ} "
-                        f"({(t1 - t0) * 1e3:.1f} ms)" if comp
+                        f"({(t1 - t0) * 1e3:.1f} ms)" if done
                         else "⋯] never completed"))
-            if comp is not None and comp.get("error"):
-                title += f"\nerror: {comp.get('error')!r}"
-            label = f"{op.f} {val!r}"
+            if done and err:
+                title += f"\nerror: {err!r}"
+            label = f"{f} {val!r}"
             parts.append(
-                f"<div class='op{'' if comp else ' open'}' "
-                f"style='left:{col_x[op['process']] + 4}px;"
+                f"<div class='op{'' if done else ' open'}' "
+                f"style='left:{col_x[p] + 4}px;"
                 f"top:{top}px;height:{hgt}px;"
                 f"background:{COLORS.get(typ, '#ddd')}' "
                 f"title='{html.escape(title, quote=True)}'>"
@@ -179,7 +225,7 @@ class TimelineHtml(Checker):
             f"<title>timeline — {name}</title>"
             f"<style>{_CSS}</style></head><body>"
             f"<h2>timeline — {name}</h2>"
-            f"<div class='meta'>{len(ops)} ops · "
+            f"<div class='meta'>{len(boxes)} ops · "
             f"{len(processes)} processes · {duration:.3f}s · "
             f"<span class='legend'>{legend}</span>"
             f"<span style='border:1px dashed #999;padding:1px 6px'>"

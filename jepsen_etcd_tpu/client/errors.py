@@ -23,15 +23,14 @@ def client_error(e: BaseException) -> bool:
 async def with_errors(op: Op, idempotent: Iterable[str],
                       thunk: Callable[[], Awaitable[Op]]) -> Op:
     """Run thunk; convert known errors to :fail / :info completions."""
-    idem = set(idempotent)
     try:
         return await thunk()
     except TimeoutError:
         e = SimError("timeout", "client timeout")
-        t = "fail" if op.get("f") in idem else "info"
+        t = "fail" if op.get("f") in idempotent else "info"
         return op.evolve(type=t, error=e.as_error_value())
     except SimError as e:
-        t = "fail" if (e.definite or op.get("f") in idem) else "info"
+        t = "fail" if (e.definite or op.get("f") in idempotent) else "info"
         return op.evolve(type=t, error=e.as_error_value())
     except Cancelled:
         raise

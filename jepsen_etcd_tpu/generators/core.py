@@ -65,22 +65,53 @@ SECOND = 1_000_000_000
 # Context
 
 
-@dataclass(frozen=True)
 class Context:
     """What a generator may observe: virtual time, free threads, workers.
 
     ``workers`` maps thread id -> current process (threads are stable; the
     process on a thread is bumped by `concurrency` when an op crashes with
     :info, cf. reference watch.clj:281-282).
+
+    A plain __slots__ class, not a dataclass: the interpreter builds and
+    restricts contexts several times per event (HOT LOOP #1), and frozen
+    dataclass construction pays object.__setattr__ per field.  Restricted
+    sub-contexts are memoized per instance — combinator walks restrict the
+    same thread sets repeatedly at one instant.
     """
 
-    time: int
-    free: frozenset  # thread ids currently free
-    workers: dict  # thread id -> process
-    rng: Any  # shared deterministic Random
-    concurrency: int
+    __slots__ = ("time", "free", "workers", "rng", "concurrency",
+                 "_sub", "_sorted_free")
+
+    def __init__(self, time: int, free: frozenset, workers: dict,
+                 rng: Any, concurrency: int):
+        self.time = time
+        self.free = free
+        self.workers = workers
+        self.rng = rng
+        self.concurrency = concurrency
+        self._sub: Optional[dict] = None
+        self._sorted_free: Optional[list] = None
+
+    def set_time(self, t: int) -> None:
+        """Advance this context — and its memoized sub-contexts, which share
+        the same clock — to virtual time t.  Lets the interpreter reuse one
+        Context (and its restrict() memo) across polls while workers/free
+        are unchanged and only time moves."""
+        if self.time == t:
+            return
+        self.time = t
+        if self._sub:
+            for c in self._sub.values():
+                c.set_time(t)
 
     def restrict(self, threads: frozenset) -> "Context":
+        memo = self._sub
+        if memo is None:
+            memo = self._sub = {}
+        else:
+            got = memo.get(threads)
+            if got is not None:
+                return got
         w = self.workers
         cache = getattr(w, "sub_cache", None)
         sub = cache.get(threads) if cache is not None else None
@@ -88,9 +119,11 @@ class Context:
             sub = _WorkersMap((t, p) for t, p in w.items() if t in threads)
             if cache is not None:
                 cache[threads] = sub
-        return Context(time=self.time, free=self.free & threads,
-                       workers=sub, rng=self.rng,
-                       concurrency=self.concurrency)
+        out = Context(time=self.time, free=self.free & threads,
+                      workers=sub, rng=self.rng,
+                      concurrency=self.concurrency)
+        memo[threads] = out
+        return out
 
     @property
     def client_threads(self) -> list:
@@ -106,7 +139,9 @@ class Context:
 
     def some_free_process(self) -> Optional[Any]:
         """Pick a free process deterministically (seeded rng)."""
-        cands = sorted(self.free, key=str)
+        cands = self._sorted_free
+        if cands is None:
+            cands = self._sorted_free = sorted(self.free, key=str)
         if not cands:
             return None
         t = self.rng.choice(cands)
@@ -205,7 +240,7 @@ class FnGen(Generator):
         raw = self._call(test, ctx)
         if raw is None:
             return None
-        op = _fill_in(dict(raw), ctx)
+        op = _fill_in(raw, ctx)  # _fill_in copies via Op(raw)
         if op is None:
             return (PENDING, None, self)
         return (op, self)
@@ -246,8 +281,12 @@ class Seq(Generator):
                 continue
             if res[0] == PENDING:
                 _, wake, head2 = res
+                if head2 is me.current:
+                    return (PENDING, wake, me)
                 return (PENDING, wake, Seq(me.items, me.idx, me.it, head2))
             op, head2 = res
+            if head2 is me.current:
+                return (op, me)
             return (op, Seq(me.items, me.idx, me.it, head2))
 
     def update(self, test, ctx, event):
@@ -286,23 +325,29 @@ class Mix(Generator):
         ctx.rng.shuffle(order)
         pend_wake = "none"
         new = list(self.gens)
+        changed = False
         for i, g in order:
             res = g.op(test, ctx)
             if res is None:
                 new[i] = None
+                changed = True
                 continue
             if res[0] == PENDING:
                 _, wake, g2 = res
-                new[i] = g2
+                if g2 is not g:
+                    new[i] = g2
+                    changed = True
                 pend_wake = _min_wake(pend_wake, wake)
                 continue
             op, g2 = res
-            new[i] = g2
-            return (op, Mix(tuple(new)))
+            if g2 is not g:
+                new[i] = g2
+                changed = True
+            return (op, Mix(tuple(new)) if changed else self)
         if all(g is None for g in new):
             return None
         return (PENDING, None if pend_wake == "none" else pend_wake,
-                Mix(tuple(new)))
+                Mix(tuple(new)) if changed else self)
 
     def update(self, test, ctx, event):
         new = tuple(g.update(test, ctx, event) if g else None
@@ -335,6 +380,8 @@ class Limit(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
+            if g2 is self.gen:
+                return (PENDING, wake, self)
             return (PENDING, wake, Limit(self.n, g2))
         op, g2 = res
         return (op, Limit(self.n - 1, g2))
@@ -366,6 +413,8 @@ class Stagger(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
+            if g2 is self.gen:
+                return (PENDING, wake, self)
             return (PENDING, wake, Stagger(self.dt, g2, self.next_time))
         op, g2 = res
         nt = self.next_time if self.next_time is not None else ctx.time
@@ -398,6 +447,8 @@ class Delay(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
+            if g2 is self.gen:
+                return (PENDING, wake, self)
             return (PENDING, wake, Delay(self.dt, g2, self.next_time))
         op, g2 = res
         nt = self.next_time if self.next_time is not None else ctx.time
@@ -424,7 +475,9 @@ class Sleep(Generator):
         dl = self.deadline if self.deadline is not None else ctx.time + self.dt
         if ctx.time >= dl:
             return None
-        return (PENDING, dl, replace(self, deadline=dl))
+        if dl == self.deadline:
+            return (PENDING, dl, self)
+        return (PENDING, dl, Sleep(self.dt, dl))
 
 
 @dataclass(frozen=True)
@@ -444,11 +497,15 @@ class TimeLimit(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
+            if g2 is self.gen and dl == self.deadline:
+                return (PENDING, _min_wake(wake, dl), self)
             return (PENDING, _min_wake(wake, dl), TimeLimit(self.t, g2, dl))
         op, g2 = res
         if op["time"] >= dl:
             # Op would fire past the deadline: the limit cuts it off.
             return None
+        if g2 is self.gen and dl == self.deadline:
+            return (op, self)
         return (op, TimeLimit(self.t, g2, dl))
 
     def update(self, test, ctx, event):
@@ -476,8 +533,12 @@ class Synchronize(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
+            if g2 is self.gen and self.started:
+                return (PENDING, wake, self)
             return (PENDING, wake, Synchronize(g2, True))
         op, g2 = res
+        if g2 is self.gen and self.started:
+            return (op, self)
         return (op, Synchronize(g2, True))
 
     def update(self, test, ctx, event):
@@ -514,8 +575,12 @@ class OnThreads(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
+            if g2 is self.gen:
+                return (PENDING, wake, self)
             return (PENDING, wake, OnThreads(self.threads, g2))
         op, g2 = res
+        if g2 is self.gen:
+            return (op, self)
         return (op, OnThreads(self.threads, g2))
 
     def update(self, test, ctx, event):
@@ -543,6 +608,7 @@ class Alt(Generator):
         pend_wake = "none"
         any_alive = False
         new = list(self.branches)
+        changed = False
         for i, b in enumerate(self.branches):
             res = b.op(test, ctx)
             if res is None:
@@ -550,7 +616,9 @@ class Alt(Generator):
             any_alive = True
             if res[0] == PENDING:
                 _, wake, b2 = res
-                new[i] = b2
+                if b2 is not b:
+                    new[i] = b2
+                    changed = True
                 pend_wake = _min_wake(pend_wake, wake)
                 continue
             op, b2 = res
@@ -558,12 +626,14 @@ class Alt(Generator):
                 best = (op, i, b2)
         if best is not None:
             op, i, b2 = best
-            new[i] = b2
-            return (op, Alt(tuple(new)))
+            if b2 is not new[i]:
+                new[i] = b2
+                changed = True
+            return (op, Alt(tuple(new)) if changed else self)
         if not any_alive:
             return None
         return (PENDING, None if pend_wake == "none" else pend_wake,
-                Alt(tuple(new)))
+                Alt(tuple(new)) if changed else self)
 
     def update(self, test, ctx, event):
         new = tuple(b.update(test, ctx, event) for b in self.branches)
@@ -596,6 +666,7 @@ class EachThread(Generator):
         pend_wake = "none"
         alive = False
         new = list(me.children)
+        changed = False
         for i, (t, g) in enumerate(me.children):
             if g is None:
                 continue
@@ -605,10 +676,13 @@ class EachThread(Generator):
             res = g.op(test, ctx.restrict(frozenset([t])))
             if res is None:
                 new[i] = (t, None)
+                changed = True
                 continue
             if res[0] == PENDING:
                 _, wake, g2 = res
-                new[i] = (t, g2)
+                if g2 is not g:
+                    new[i] = (t, g2)
+                    changed = True
                 pend_wake = _min_wake(pend_wake, wake)
                 continue
             op, g2 = res
@@ -617,14 +691,17 @@ class EachThread(Generator):
         if best is not None:
             op, i, g2 = best
             t = new[i][0]
-            new[i] = (t, g2)
-            return (op, replace(me, children=tuple(new)))
+            if g2 is not new[i][1]:
+                new[i] = (t, g2)
+                changed = True
+            return (op, EachThread(me.spec, tuple(new), me.done)
+                    if changed else me)
         if not any(g is not None for _, g in new):
             return None
         if not alive:
             return None
         return (PENDING, None if pend_wake == "none" else pend_wake,
-                replace(me, children=tuple(new)))
+                EachThread(me.spec, tuple(new), me.done) if changed else me)
 
     def update(self, test, ctx, event):
         if self.children is None:
@@ -654,9 +731,10 @@ class FMap(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
-            return (PENDING, wake, FMap(self.f, g2))
+            return (PENDING, wake,
+                    self if g2 is self.gen else FMap(self.f, g2))
         op, g2 = res
-        return (self.f(op), FMap(self.f, g2))
+        return (self.f(op), self if g2 is self.gen else FMap(self.f, g2))
 
     def update(self, test, ctx, event):
         if self.gen is None:
@@ -682,20 +760,24 @@ class Cycle(Generator):
                 if me.times is not None and me.times <= 1:
                     return None
                 nt = None if me.times is None else me.times - 1
-                me = replace(me, current=None, times=nt)
+                me = Cycle(me.spec, None, nt)
                 continue
             if res[0] == PENDING:
                 _, wake, g2 = res
-                return (PENDING, wake, replace(me, current=g2))
+                if g2 is me.current:
+                    return (PENDING, wake, me)
+                return (PENDING, wake, Cycle(me.spec, g2, me.times))
             op, g2 = res
-            return (op, replace(me, current=g2))
+            if g2 is me.current:
+                return (op, me)
+            return (op, Cycle(me.spec, g2, me.times))
         return (PENDING, None, me)
 
     def update(self, test, ctx, event):
         if self.current is None:
             return self
         g2 = self.current.update(test, ctx, event)
-        return self if g2 is self.current else replace(self, current=g2)
+        return self if g2 is self.current else Cycle(self.spec, g2, self.times)
 
 
 # ---------------------------------------------------------------------------
@@ -785,9 +867,10 @@ class _ClientsOnly(Generator):
             return None
         if res[0] == PENDING:
             _, wake, g2 = res
-            return (PENDING, wake, _ClientsOnly(g2))
+            return (PENDING, wake,
+                    self if g2 is self.gen else _ClientsOnly(g2))
         op, g2 = res
-        return (op, _ClientsOnly(g2))
+        return (op, self if g2 is self.gen else _ClientsOnly(g2))
 
     def update(self, test, ctx, event):
         if self.gen is None or not isinstance(event.get("process"), int):
@@ -855,8 +938,12 @@ class Reserve(Generator):
             return None
         if res[0] == PENDING:
             _, wake, alt2 = res
+            if alt2 is me.resolved:
+                return (PENDING, wake, me)
             return (PENDING, wake, Reserve(me.counts, me.gens, alt2))
         op, alt2 = res
+        if alt2 is me.resolved:
+            return (op, me)
         return (op, Reserve(me.counts, me.gens, alt2))
 
     def update(self, test, ctx, event):

@@ -78,71 +78,72 @@ class ConcurrentGenerator(Generator):
                 f"fewer than group size {self.n}")
         return replace(self, groups=tuple(gs))
 
-    def _fresh(self, me: "ConcurrentGenerator", i: int):
-        """Give group i a new key's generator; returns (me', group) or
-        (me', None) when the key sequence is exhausted."""
-        k = me.keys.get(me.next_key)
-        if k is EXHAUSTED:
-            return me, None
-        threads = me.groups[i][0]
-        child = ensure_gen(me.gen_fn(k))
-        group = (threads, k, child)
-        gs = list(me.groups)
-        gs[i] = group
-        return replace(me, groups=tuple(gs), next_key=me.next_key + 1), group
-
     def op(self, test, ctx):
         me = self._resolve(ctx)
-        best = None  # (op, i, key, gen2)
+        # One mutable copy of the group table per poll; a new generator
+        # instance is built at most once, and only when something moved.
+        gs = list(me.groups)
+        next_key = me.next_key
+        changed = False
+        best = None  # (op, i, key, gen2, threads)
         pend_wake = "none"
-        pending_any = False
-        for i in range(len(me.groups)):
-            threads, key, g = me.groups[i]
+        for i in range(len(gs)):
+            threads, key, g = gs[i]
             if g is None:
-                me, group = self._fresh(me, i)
-                if group is None:
+                k = me.keys.get(next_key)
+                if k is EXHAUSTED:
                     continue  # keys exhausted; group retires
-                threads, key, g = group
+                next_key += 1
+                key, g = k, ensure_gen(me.gen_fn(k))
+                gs[i] = (threads, key, g)
+                changed = True
             sub = ctx.restrict(threads)
             # A group may need several polls if its gen exhausts: move to
             # the next key immediately.
             while True:
                 res = g.op(test, sub)
                 if res is None:
-                    me, group = self._fresh(me, i)
-                    if group is None:
+                    k = me.keys.get(next_key)
+                    if k is EXHAUSTED:
                         g = None
                         break
-                    threads, key, g = group
+                    next_key += 1
+                    key, g = k, ensure_gen(me.gen_fn(k))
+                    gs[i] = (threads, key, g)
+                    changed = True
                     continue
                 break
             if g is None:
-                gs = list(me.groups)
-                gs[i] = (me.groups[i][0], None, None)
-                me = replace(me, groups=tuple(gs))
+                gs[i] = (threads, None, None)
+                changed = True
                 continue
             if res[0] == PENDING:
                 _, wake, g2 = res
                 pend_wake = _min_wake(pend_wake, wake)
-                pending_any = True
-                gs = list(me.groups)
-                gs[i] = (threads, key, g2)
-                me = replace(me, groups=tuple(gs))
+                if g2 is not g:
+                    gs[i] = (threads, key, g2)
+                    changed = True
                 continue
             op, g2 = res
             if best is None or op["time"] < best[0]["time"]:
                 best = (op, i, key, g2, threads)
         if best is not None:
             op, i, key, g2, threads = best
-            gs = list(me.groups)
-            gs[i] = (threads, key, g2)
-            me = replace(me, groups=tuple(gs))
+            if g2 is not gs[i][2]:
+                gs[i] = (threads, key, g2)
+                changed = True
+            if changed:
+                me = ConcurrentGenerator(me.n, me.keys, me.gen_fn,
+                                         tuple(gs), next_key)
             wrapped = op.evolve(value=(key, op.get("value")))
             return (wrapped, me)
-        alive = any(g is not None for _, _, g in me.groups) \
-            or me.keys.get(me.next_key) is not EXHAUSTED
+        alive = any(g is not None for _, _, g in gs) \
+            or me.keys.get(next_key) is not EXHAUSTED
         if not alive:
             return None
+        if changed:
+            me = ConcurrentGenerator(me.n, me.keys, me.gen_fn,
+                                     tuple(gs), next_key)
         return (PENDING, None if pend_wake == "none" else pend_wake, me)
 
     def update(self, test, ctx, event):
@@ -159,7 +160,8 @@ class ConcurrentGenerator(Generator):
                     return self
                 gs = list(self.groups)
                 gs[i] = (threads, key, g2)
-                return replace(self, groups=tuple(gs))
+                return ConcurrentGenerator(self.n, self.keys, self.gen_fn,
+                                           tuple(gs), self.next_key)
         return self
 
 

@@ -634,38 +634,68 @@ def _classify_info(pos, f, ev, ilists):
     i2v_l.append(x2)
 
 
-def _extract_key_columns(ops, lists, ilists):
+def _rows_from_ops(ops):
+    """Parallel (procs, types, fs, vals) row lists from dict ops — the
+    dict front-end of _extract_key_columns. One pass of dict lookups,
+    the same count the fused loop used to pay inline."""
+    procs, types, fs, vals = [], [], [], []
+    for op in ops:
+        procs.append(op.get("process"))
+        types.append(op.get("type"))
+        fs.append(op.get("f"))
+        vals.append(op.get("value"))
+    return procs, types, fs, vals
+
+
+def _rows_from_columns(cols):
+    """Parallel row lists straight from SoA columns (core/history.py
+    OpColumns) — zero per-op dict access: type/f decode through their
+    intern tables, non-int processes decode from proc_table, and the
+    values list is shared by reference (per-key sub-columns already
+    hold unwrapped payloads, exactly what the dict subhistory path
+    feeds the extraction loop)."""
+    from ..core.history import TYPE_NAMES
+    types = [TYPE_NAMES[c] for c in cols.type_code.tolist()]
+    pt = cols.proc_table
+    procs = [p if p >= 0 else pt[-1 - p] for p in cols.proc.tolist()]
+    ft = cols.f_table
+    fs = [ft[c] for c in cols.f_code.tolist()]
+    return procs, types, fs, cols.values
+
+
+def _extract_key_columns(rows, lists, ilists):
     """ONE merged pass over a key's raw ops: invoke/completion pairing
     (history_entries), required-op classification, and register-language
-    field extraction fused into a single loop so each op pays one round
-    of dict access instead of three (Entry construction + re-parse).
+    field extraction fused into a single loop. ``rows`` is the
+    (procs, types, fs, vals) parallel-list form of the ops — built by
+    _rows_from_ops (dict histories) or _rows_from_columns (SoA-backed
+    histories, no dict round-trip).
     Appends required-op columns to the shared flat ``lists`` (and
     indefinite updates to ``ilists``); returns the number of required
     ops appended. Raises _Delegate on anything the vectorized phase
     can't express bit-identically: non-int payload values (interning
     needs Python == semantics), non-int or out-of-range version
     assertions, unsupported fs, and malformed value shapes."""
+    procs, types, fs, vals = rows
     inv_l, ret_l, f_l, ver_l, v1t_l, v1v_l, v2t_l, v2v_l = lists
     open_by: dict = {}
     pos = 0
     n_req = 0
     lo_ver, hi_ver = -(2 ** 29), 2 ** 29
-    for op in ops:
-        proc = op.get("process")
+    for i, proc in enumerate(procs):
         if not isinstance(proc, int):
             continue
         pos += 1
-        t = op.get("type")
+        t = types[i]
         if t == "invoke":
-            open_by[proc] = (pos, op)
+            open_by[proc] = (pos, i)
             continue
         got = open_by.pop(proc, None)
         if got is None or t == "fail":
             continue
         if t == "ok":
-            inv = got[1]
-            f = inv["f"]
-            ev = op.get("value")
+            f = fs[got[1]]
+            ev = vals[i]
             # 2-unpacks mirror the reference exactly (it unpacks any
             # 2-iterable); failures surface as TypeError/ValueError,
             # which the caller converts to delegation — and the
@@ -726,18 +756,17 @@ def _extract_key_columns(ops, lists, ilists):
             v2v_l.append(x2)
             n_req += 1
         elif t == "info":
-            inv = got[1]
-            f = inv["f"]
+            f = fs[got[1]]
             if f != "read":           # indefinite update
-                _classify_info(got[0], f, inv.get("value"), ilists)
+                _classify_info(got[0], f, vals[got[1]], ilists)
             # info reads are dropped up front (assert nothing)
         else:
             open_by[proc] = got       # ad-hoc type: leave the op open
     # ops still open at history end: indefinite, like :info completions
-    for ppos, inv in open_by.values():
-        f = inv["f"]
+    for ppos, inv_i in open_by.values():
+        f = fs[inv_i]
         if f != "read":
-            _classify_info(ppos, f, inv.get("value"), ilists)
+            _classify_info(ppos, f, vals[inv_i], ilists)
     return n_req
 
 
@@ -871,11 +900,19 @@ def pack_register_histories_batched(subhistories: dict,
         if adapter is not None:
             out[key] = _pack_reference(h, adapter=adapter)
             continue
-        ops = h.ops if isinstance(h, History) else h
+        # column-backed per-key histories (Independent's split of a
+        # recorded run) extract straight from the SoA arrays — the
+        # dict op stream is never materialized on this path
+        cols = getattr(h, "columns", None) if isinstance(h, History) \
+            else None
+        if cols is not None:
+            rows = _rows_from_columns(cols)
+        else:
+            rows = _rows_from_ops(h.ops if isinstance(h, History) else h)
         marks = [len(c) for c in alllists]
         imark = len(ipos_l)
         try:
-            n_req = _extract_key_columns(ops, lists, ilists)
+            n_req = _extract_key_columns(rows, lists, ilists)
         except (_Delegate, TypeError, ValueError):
             # TypeError/ValueError: a value didn't 2-unpack the way the
             # op's ``f`` demands — the reference raises the identical

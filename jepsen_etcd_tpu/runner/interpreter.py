@@ -23,9 +23,9 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 from ..core.op import Op, NEMESIS, INFO
-from ..core.history import History
+from ..core.history import History, ColumnsBuilder
 from ..generators.core import Context, ensure_gen, PENDING, _WorkersMap
-from .sim import SimLoop, Queue, current_loop, sleep, wait_for
+from .sim import Future, SimLoop, Queue, current_loop
 
 import logging
 
@@ -54,29 +54,44 @@ async def interpret(
     events: Queue = Queue(loop)  # ("invoke"|"complete", thread, op)
     history: list[Op] = []
     index = [0]
+    # SoA columns emitted alongside the dict stream: one row per event,
+    # so checkers can consume typed arrays with no per-op dict access
+    # (core/history.py OpColumns; schema in OBSERVABILITY.md §columns)
+    columns = ColumnsBuilder()
+    col_append = columns.append
 
     def record(op: Op) -> Op:
-        op = op.evolve(index=index[0], time=loop.now)
+        op = Op(op)  # evolve() unrolled: one copy, two direct stores
+        op["index"] = index[0]
+        op["time"] = loop.now
         index[0] += 1
         history.append(op)
+        col_append(op)
         if on_op is not None:
             on_op(op)
         return op
 
     # Snapshots shared across polls until the underlying sets mutate: ctx()
-    # runs several times per op, and restrict() memoizes subset dicts on the
-    # workers snapshot (see generators.core._WorkersMap).  Snapshots are
-    # replaced on change, never mutated, so handing them out is safe.
-    snap: dict = {"workers": None, "free": None}
+    # runs several times per op, and restrict() memoizes sub-contexts on the
+    # Context instance (see generators.core.Context).  The Context itself is
+    # cached too — across polls only virtual time moves, which set_time()
+    # propagates in place — so the restrict() memo survives between polls.
+    snap: dict = {"workers": None, "free": None, "ctx": None}
 
     def ctx() -> Context:
+        c = snap["ctx"]
+        if c is not None:
+            c.set_time(loop.now)
+            return c
         if snap["workers"] is None:
             snap["workers"] = _WorkersMap(workers)
         if snap["free"] is None:
             snap["free"] = frozenset(free)
-        return Context(time=loop.now, free=snap["free"],
-                       workers=snap["workers"], rng=loop.rng,
-                       concurrency=concurrency)
+        c = Context(time=loop.now, free=snap["free"],
+                    workers=snap["workers"], rng=loop.rng,
+                    concurrency=concurrency)
+        snap["ctx"] = c
+        return c
 
     async def worker(thread: Any) -> None:
         while True:
@@ -84,8 +99,10 @@ async def interpret(
             if op is None:
                 return
             if op["time"] > loop.now:
-                await sleep(op["time"] - loop.now)
-            op = op.evolve(process=workers[thread])
+                await loop.sleep(op["time"] - loop.now)
+            p = workers[thread]
+            if op.get("process") != p:
+                op = op.evolve(process=p)
             events.put(("invoke", thread, op))
             try:
                 if thread == NEMESIS:
@@ -103,6 +120,7 @@ async def interpret(
             if done.get("type") == INFO and isinstance(thread, int):
                 workers[thread] = workers[thread] + concurrency
                 snap["workers"] = None
+                snap["ctx"] = None
             events.put(("complete", thread, done))
 
     tasks = [loop.spawn(worker(t), name=f"worker-{t}") for t in threads]
@@ -115,22 +133,79 @@ async def interpret(
             if outstanding[thread] == 0:
                 free.add(thread)
                 snap["free"] = None
+                snap["ctx"] = None
         if gen is not None:
             gen = gen.update(test, ctx(), op)
 
+    _DEADLINE = object()  # sentinel: next_event gave up waiting
+
     async def next_event(deadline: Optional[int] = None) -> None:
-        """Handle one event; give up at deadline (virtual time) if given."""
+        """Handle one event; give up at deadline (virtual time) if given.
+
+        The deadline path used to be ``wait_for(spawn(events.get()),
+        dt)`` — a Task + coroutine + 2 Futures per poll, twice per op in
+        rate-0 runs.  This open-codes the same dance with two plain
+        bounce callbacks.  The bounces are not an accident: they
+        reproduce the old shape's scheduler hops (task wakeup, then
+        wait_for's on_done) so every externally visible callback keeps
+        its exact (time, seq) order relative to worker puts — histories
+        stay bit-identical to the task-based implementation.
+        """
         if deadline is None:
             kind, thread, op = await events.get()
         else:
             if loop.now >= deadline:
                 return
-            try:
-                kind, thread, op = await wait_for(
-                    loop.spawn(events.get(), name="evget"),
-                    deadline - loop.now)
-            except TimeoutError:
+            f = loop.future()       # the queue getter (was: evget's)
+            gate = loop.future()    # what we actually await
+            got_item = False        # ~ "the evget task completed"
+
+            def hop1(fut) -> None:  # ~ evget task wakeup + step
+                nonlocal got_item
+                got_item = True
+                loop._push_soon(hop2, (fut,))
+
+            def hop2(fut) -> None:  # ~ wait_for's on_done
+                timer.cancel()
+                if not gate._state:
+                    gate.set_result(fut._result)
+
+            def on_timeout() -> None:
+                if not gate._state:
+                    gate.set_result(_DEADLINE)
+
+            if len(events):
+                # unreachable in practice (the main loop drains the queue
+                # synchronously before polling), kept for safety
+                kind, thread, op = await events.get()
+                handle(kind, thread, op)
                 return
+            events._getters.append(f)
+            f.add_done_callback(hop1)
+            timer = loop.call_at(deadline, on_timeout)
+            got = await gate
+            if got is _DEADLINE:
+                if got_item:
+                    # delivery raced the deadline and won (the old code's
+                    # "task.done despite timeout" branch): handle it
+                    kind, thread, op = f._result
+                    handle(kind, thread, op)
+                    return
+                # ~ task.cancel(): the stale getter is cleaned up one
+                # scheduler hop later, with Queue.get's re-route semantics
+                # for an item delivered into the window
+                def cleanup() -> None:
+                    if f in events._getters:
+                        events._getters.remove(f)
+                    elif f._state == Future.DONE:
+                        if events._getters:
+                            events._getters.popleft().set_result(f._result)
+                        else:
+                            events._items.appendleft(f._result)
+
+                loop._push_soon(cleanup, ())
+                return
+            kind, thread, op = got
         handle(kind, thread, op)
 
     while True:
@@ -164,6 +239,7 @@ async def interpret(
         if thread in free:
             free.discard(thread)
             snap["free"] = None
+            snap["ctx"] = None
         outstanding[thread] += 1
         inboxes[thread].put(op)
 
@@ -171,4 +247,4 @@ async def interpret(
         inboxes[t].put(None)  # retire workers
     for t in tasks:
         await t
-    return History(history)
+    return History(history, columns=columns.finish())

@@ -43,25 +43,29 @@ class Future:
         self.loop = loop
         self._state = Future.PENDING
         self._result: Any = None
-        self._callbacks: list[Callable[["Future"], None]] = []
+        # lazily allocated: most futures (sleeps, queue getters) collect
+        # exactly one callback, many collect none before resolution
+        self._callbacks: Optional[list] = None
 
     @property
     def done(self) -> bool:
         return self._state != Future.PENDING
 
     def set_result(self, value: Any) -> None:
-        if self.done:
+        if self._state:
             return
         self._state = Future.DONE
         self._result = value
-        self._fire()
+        if self._callbacks:
+            self._fire()
 
     def set_exception(self, exc: BaseException) -> None:
-        if self.done:
+        if self._state:
             return
         self._state = Future.ERROR
         self._result = exc
-        self._fire()
+        if self._callbacks:
+            self._fire()
 
     def result(self) -> Any:
         if self._state == Future.DONE:
@@ -71,18 +75,21 @@ class Future:
         raise RuntimeError("future not done")
 
     def add_done_callback(self, cb: Callable[["Future"], None]) -> None:
-        if self.done:
-            self.loop.call_soon(cb, self)
+        if self._state:
+            self.loop._push_soon(cb, (self,))
+        elif self._callbacks is None:
+            self._callbacks = [cb]
         else:
             self._callbacks.append(cb)
 
     def _fire(self) -> None:
-        cbs, self._callbacks = self._callbacks, []
+        cbs, self._callbacks = self._callbacks, None
+        push = self.loop._push_soon
         for cb in cbs:
-            self.loop.call_soon(cb, self)
+            push(cb, (self,))
 
     def __await__(self) -> Generator["Future", None, Any]:
-        if not self.done:
+        if not self._state:
             yield self
         return self.result()
 
@@ -98,20 +105,20 @@ class Task(Future):
         self.name = name
         self._waiting_on: Optional[Future] = None
         self._cancel_requested = False
-        loop.call_soon(self._step, None, None)
+        loop._push_soon(self._step, (None, None))
 
     def cancel(self, exc: BaseException | None = None) -> None:
         """Throw Cancelled into the coroutine at its next suspension point."""
-        if self.done:
+        if self._state:
             return
         self._cancel_requested = True
         # Detach from whatever we were awaiting (its wakeup becomes stale)
         # and resume with the cancellation.
         self._waiting_on = None
-        self.loop.call_soon(self._step, None, exc or Cancelled())
+        self.loop._push_soon(self._step, (None, exc or Cancelled()))
 
     def _wakeup(self, fut: Future) -> None:
-        if self.done or self._waiting_on is not fut:
+        if self._state or self._waiting_on is not fut:
             return  # stale wakeup (e.g. cancelled meanwhile)
         self._waiting_on = None
         if fut._state == Future.ERROR:
@@ -120,7 +127,7 @@ class Task(Future):
             self._step(fut._result, None)
 
     def _step(self, value: Any, exc: BaseException | None) -> None:
-        if self.done:
+        if self._state:
             return
         if self._cancel_requested and exc is None:
             exc = Cancelled()
@@ -149,15 +156,29 @@ class Task(Future):
 
 
 class Timer:
-    """Handle for a scheduled callback; cancel() makes it a silent no-op."""
+    """Handle for a scheduled callback; cancel() makes it a silent no-op.
 
-    __slots__ = ("_entry",)
+    Cancellation leaves a tombstone entry in the owning loop's heap; the
+    loop counts them and compacts the heap once tombstones dominate (a
+    cancel-heavy nemesis schedule would otherwise grow the heap without
+    bound, and every push would pay log(dead + live)).
+    """
 
-    def __init__(self, entry: list):
+    __slots__ = ("_entry", "_loop")
+
+    def __init__(self, entry: list, loop: "SimLoop"):
         self._entry = entry
+        self._loop = loop
 
     def cancel(self) -> None:
-        self._entry[2] = None
+        entry = self._entry
+        if entry[2] is not None:
+            entry[2] = None
+            loop = self._loop
+            loop._dead += 1
+            if loop._dead > loop.COMPACT_FLOOR and \
+                    loop._dead * 2 > len(loop._heap):
+                loop._compact()
 
     @property
     def cancelled(self) -> bool:
@@ -167,19 +188,34 @@ class Timer:
 class SimLoop:
     """Deterministic discrete-event scheduler with a virtual clock."""
 
+    #: minimum tombstone count before heap compaction kicks in — below
+    #: this, a filter + heapify costs more than just popping the dead
+    COMPACT_FLOOR = 64
+
     def __init__(self, seed: int = 0):
         self.now: int = 0  # virtual ns
         self.rng = random.Random(seed)
         self._heap: list[list] = []  # [time, seq, cb_or_None, args]
         self._seq = itertools.count()
         self._current_task: Optional[Task] = None
+        self._dead = 0  # cancelled entries still in the heap
         self.tasks: list[Task] = []
 
     # -- scheduling ---------------------------------------------------------
+    def _push_soon(self, cb: Callable, args: tuple) -> None:
+        """Hot-path call_soon: no Timer handle, no clamping."""
+        heapq.heappush(self._heap, [self.now, next(self._seq), cb, args])
+
+    def _push_at(self, t: int, cb: Callable, args: tuple) -> None:
+        """Hot-path call_at: no Timer handle."""
+        if t < self.now:
+            t = self.now
+        heapq.heappush(self._heap, [t, next(self._seq), cb, args])
+
     def call_at(self, t: int, cb: Callable, *args: Any) -> Timer:
         entry = [max(int(t), self.now), next(self._seq), cb, args]
         heapq.heappush(self._heap, entry)
-        return Timer(entry)
+        return Timer(entry, self)
 
     def call_later(self, dt: int, cb: Callable, *args: Any) -> Timer:
         return self.call_at(self.now + int(dt), cb, *args)
@@ -196,30 +232,53 @@ class SimLoop:
     def sleep(self, dt: float) -> Future:
         """Await to pause for dt virtual ns."""
         f = Future(self)
-        self.call_later(int(dt), f.set_result, None)
+        self._push_at(self.now + int(dt), f.set_result, (None,))
         return f
 
     def future(self) -> Future:
         return Future(self)
 
+    def _compact(self) -> None:
+        """Drop tombstoned entries and restore the heap invariant.
+
+        heapify preserves the total (time, seq) order of live entries, so
+        compaction can never reorder callbacks.
+        """
+        heap = self._heap
+        heap[:] = [e for e in heap if e[2] is not None]
+        heapq.heapify(heap)
+        self._dead = 0
+
     # -- running ------------------------------------------------------------
     def run(self, until: Optional[Future] = None, max_time: Optional[int] = None) -> Any:
         """Run until `until` completes (or the heap drains)."""
-        while self._heap:
-            if self._heap[0][2] is None:  # cancelled timer: drop silently,
-                heapq.heappop(self._heap)  # without advancing the clock
+        heap = self._heap
+        pop = heapq.heappop
+        while heap:
+            head = heap[0]
+            if head[2] is None:  # cancelled timer: drop silently,
+                pop(heap)        # without advancing the clock
+                self._dead -= 1
                 continue
-            if until is not None and until.done and self._heap[0][0] > self.now:
+            t = head[0]
+            if until is not None and until._state and t > self.now:
                 # Drain same-instant callbacks (e.g. cancellations issued in
                 # the completing step) before stopping.
                 break
-            if max_time is not None and self._heap[0][0] > max_time:
+            if max_time is not None and t > max_time:
                 self.now = max_time
                 break  # event stays queued for a later run()
-            entry = heapq.heappop(self._heap)
-            t, _, cb, args = entry
             self.now = t
-            cb(*args)
+            # batch: every entry sharing this timestamp drains in one
+            # pass, in (time, seq) pop order — entries a callback pushes
+            # at the same instant join the batch, exactly as before
+            while heap and heap[0][0] == t:
+                entry = pop(heap)
+                cb = entry[2]
+                if cb is None:
+                    self._dead -= 1
+                    continue
+                cb(*entry[3])
         if until is not None:
             if not until.done:
                 raise RuntimeError(

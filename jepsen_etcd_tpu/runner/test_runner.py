@@ -24,6 +24,24 @@ from .telemetry import Telemetry
 logger = logging.getLogger("jepsen_etcd_tpu.run")
 
 
+def _tally_generate(tel, history, wall_s: float) -> None:
+    """Pinned generation counters (OBSERVABILITY.md §counters):
+    ``generate.ops_per_s`` is recorded events per generate-phase wall
+    second; the ``columns.*`` counters say whether (and how much of)
+    the run's op stream was emitted as SoA columns alongside the
+    dicts. mode="max" keeps each a plain value, not a running sum."""
+    tel.counter("generate.ops_per_s",
+                round(len(history) / max(wall_s, 1e-9), 1), mode="max")
+    cols = getattr(history, "columns", None)
+    if cols is None:
+        tel.counter("columns.disabled", 1, mode="max")
+        return
+    tel.counter("columns.events", len(cols), mode="max")
+    tel.counter("columns.keyed", int((cols.key_id >= 0).sum()),
+                mode="max")
+    tel.counter("columns.extras", len(cols.extras), mode="max")
+
+
 def _make_telemetry(test: dict, store_dir: str):
     """Install the run's telemetry recorder (``--no-telemetry`` opts
     out; every other run writes telemetry.jsonl with no flag needed)."""
@@ -101,6 +119,16 @@ def run_test(test: dict) -> dict:
     loop = SimLoop(seed=seed)
     set_current_loop(loop)
     t0 = wall_time.time()
+    # The sim allocates millions of short-lived objects per run; cyclic GC
+    # walks the ever-growing live graph (history, logs, WAL records) on
+    # allocation thresholds and was measured costing 20-40% of generation
+    # wall time, with multi-second run-to-run variance. Refcounting
+    # reclaims the sim's true garbage; one collect at the end handles the
+    # few cycles (tasks/coroutines).
+    import gc
+    gc_was_enabled = gc.isenabled()
+    if gc_was_enabled:
+        gc.disable()
     # store dir exists before ops run, so debug-mode provenance can embed
     # the run's dir name in written values (the reference's store/path is
     # likewise available during the run, append.clj:40)
@@ -153,11 +181,13 @@ def run_test(test: dict) -> dict:
                     await nemesis_obj.setup(test)
                 await pool.setup_initial(test["concurrency"])
             logger.info("Running generator")
+            g0 = wall_time.time()
             with tel_now.span("phase:generate") as sp:
                 h = await interpret(test, test["generator"], invoke,
                                     test["concurrency"],
                                     nemesis_invoke=nemesis_invoke)
                 sp.set(ops=len(h))
+            _tally_generate(tel_now, h, wall_time.time() - g0)
             with tel_now.span("phase:teardown"):
                 await pool.teardown()
                 if nemesis_obj is not None:
@@ -191,6 +221,13 @@ def run_test(test: dict) -> dict:
         telemetry.set_current(None)
         if tel is not None:
             tel.close()
+        if gc_was_enabled:
+            # re-enable only, no collect: at this point the run's object
+            # graph is still reachable through the caller's test dict, so
+            # a collect here would scan millions of live objects and free
+            # almost nothing. Ambient GC reclaims the cycles (tasks,
+            # coroutine frames) once the caller drops the test.
+            gc.enable()
 
 
 def _analyze_and_save(test: dict, history, store_dir: str, cluster,
@@ -280,11 +317,13 @@ def run_test_live(test: dict) -> dict:
                     await nemesis_obj.setup(test)
                 await pool.setup_initial(test["concurrency"])
             logger.info("Running generator (wall clock)")
+            g0 = wall_time.time()
             with tel_now.span("phase:generate") as sp:
                 h = await interpret(test, test["generator"], invoke,
                                     test["concurrency"],
                                     nemesis_invoke=nemesis_invoke)
                 sp.set(ops=len(h))
+            _tally_generate(tel_now, h, wall_time.time() - g0)
             with tel_now.span("phase:teardown"):
                 await pool.teardown()
                 if nemesis_obj is not None:

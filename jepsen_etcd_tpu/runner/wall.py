@@ -89,6 +89,7 @@ class WallLoop(SimLoop):
                 entry = heapq.heappop(self._heap)
                 t, _, cb, args = entry
                 if cb is None:
+                    self._dead -= 1
                     continue  # cancelled
                 self.now = max(self._wall(), t)
                 cb(*args)
@@ -102,6 +103,7 @@ class WallLoop(SimLoop):
                     continue
                 while self._heap and self._heap[0][2] is None:
                     heapq.heappop(self._heap)  # drop cancelled heads
+                    self._dead -= 1
                 # idle only when no timers AND no pool work in flight:
                 # a pending run_in_thread completion arrives via
                 # call_soon_threadsafe and must not be dropped by an

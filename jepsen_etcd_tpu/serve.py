@@ -93,6 +93,8 @@ def _run_rows(store_base: str) -> list[dict]:
                      "time_limit": test.get("time_limit"),
                      "ops": ops,
                      "phases": tel.get("phases") or {},
+                     "gen_rate": (tel.get("counters") or {})
+                     .get("generate.ops_per_s"),
                      "signature": _failure_signature(results)})
     rows.sort(key=lambda r: r["mtime"], reverse=True)
     return rows
@@ -191,12 +193,17 @@ def aggregate_html(store_base: str) -> str:
     # -- per-run phase breakdown bars ----------------------------------------
     out.append("<h2>Phase breakdown (wall time per run)</h2>"
                "<table><tr><th>run</th><th>valid?</th>"
-               "<th>phases</th></tr>")
+               "<th>gen ops/s</th><th>phases</th></tr>")
     for r in rows:
+        rate = r.get("gen_rate")
+        rate_td = (f"<td>{rate:,.0f}</td>"
+                   if isinstance(rate, (int, float))
+                   else "<td class='dim'>—</td>")
         out.append(
             f'<tr><td><a href="/{quote(r["dir"])}/">'
             f'{html.escape(r["dir"])}</a></td>'
             f"<td>{_badge(r['valid?'])}</td>"
+            f"{rate_td}"
             f"<td>{_phase_bar(r['phases'])}</td></tr>")
     out.append("</table><p class='dim'>"
                + " ".join(f"<span class='bar' style='width:12px;"

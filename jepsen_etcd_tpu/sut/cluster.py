@@ -37,7 +37,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
-from ..runner.sim import SimLoop, Future, Event as SimEvent, sleep, SECOND
+from ..runner.sim import SimLoop, Future, Event as SimEvent, SECOND
 from .errors import SimError
 from .store import Store, Txn, Event
 from . import wal as walmod
@@ -392,7 +392,7 @@ class Cluster:
 
     async def _tick_loop(self) -> None:
         while self.running:
-            await sleep(self.cfg.tick)
+            await self.loop.sleep(self.cfg.tick)
             for n in list(self.nodes.values()):
                 if not n.alive or n.paused or n.removed:
                     continue
@@ -452,7 +452,7 @@ class Cluster:
                             last_index: int) -> None:
         # request leg: delivered only if both ends are up and connected
         # at arrival time (same drop model as _send_append)
-        await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+        await self.loop.sleep(self.loop.rng.randint(*self.cfg.repl_delay))
         peer = self.nodes.get(peer_name)
         if (peer is None or peer.removed
                 or not self.reachable(cand.name, peer_name)):
@@ -477,7 +477,7 @@ class Cluster:
                 granted = True
         resp_term = peer.term
         # response leg
-        await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+        await self.loop.sleep(self.loop.rng.randint(*self.cfg.repl_delay))
         delivered = self.reachable(peer_name, cand.name)
         self._trace("vote-resp", peer_name, cand.name, term=resp_term,
                     granted=granted, delivered=delivered)
@@ -555,7 +555,7 @@ class Cluster:
 
     async def _send_append(self, leader: Node, peer_name: str) -> None:
         try:
-            await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+            await self.loop.sleep(self.loop.rng.randint(*self.cfg.repl_delay))
         finally:
             # past the coalescing window: appends after this point need
             # (and will get) a fresh sender. Cleared in finally — a
@@ -765,7 +765,7 @@ class Cluster:
         n = self.nodes.get(node_name)
         if n is None:
             raise SimError("unavailable", f"unknown node {node_name}")
-        await sleep(self.loop.rng.randint(*self.cfg.rpc_delay))
+        await self.loop.sleep(self.loop.rng.randint(*self.cfg.rpc_delay))
         if not n.alive:
             raise SimError("connect-failed", node_name)
         if n.removed:
@@ -787,9 +787,9 @@ class Cluster:
                 return node
             leader = self.current_leader_visible(node)
             if leader is not None:
-                await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+                await self.loop.sleep(self.loop.rng.randint(*self.cfg.repl_delay))
                 return leader
-            await sleep(self.cfg.heartbeat_interval)
+            await self.loop.sleep(self.cfg.heartbeat_interval)
             if not node.alive:
                 raise SimError("unavailable", node.name)
 
@@ -798,7 +798,7 @@ class Cluster:
         n = await self._enter(node_name)
         leader = await self._at_leader(n)
         result = await self.propose(leader.name, "txn", txn)
-        await sleep(self.loop.rng.randint(*self.cfg.rpc_delay))
+        await self.loop.sleep(self.loop.rng.randint(*self.cfg.rpc_delay))
         return result
 
     async def kv_read(self, node_name: str, key: str,
@@ -811,7 +811,7 @@ class Cluster:
         leader = await self._at_leader(n)
         await self._read_index(leader)
         out = {"kv": leader.store.get(key), "revision": leader.store.revision}
-        await sleep(self.loop.rng.randint(*self.cfg.rpc_delay))
+        await self.loop.sleep(self.loop.rng.randint(*self.cfg.rpc_delay))
         return out
 
     def _committed_own_term(self, leader: Node) -> bool:
@@ -844,13 +844,13 @@ class Cluster:
         entries its predecessor acked.
         """
         while True:
-            await sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+            await self.loop.sleep(self.loop.rng.randint(*self.cfg.repl_delay))
             if not leader.alive:
                 raise SimError("unavailable", leader.name)
             if leader.role != "leader":
                 raise SimError("leader-changed", leader.name)
             if not self._committed_own_term(leader):
-                await sleep(self.cfg.heartbeat_interval)
+                await self.loop.sleep(self.cfg.heartbeat_interval)
                 continue
             acks = 0
             for m in leader.membership:
@@ -870,7 +870,7 @@ class Cluster:
                 acks += 1
             if acks >= leader.majority():
                 return
-            await sleep(self.cfg.heartbeat_interval)
+            await self.loop.sleep(self.cfg.heartbeat_interval)
 
     async def range_read(self, node_name: str, prefix: str,
                          serializable: bool = False) -> list[dict]:
@@ -930,7 +930,7 @@ class Cluster:
                                f"lock key lost (lease {lid:x} expired?)")
             if min(waiters, key=lambda kv: kv["create-revision"])["key"] == key:
                 return key
-            await sleep(self.cfg.heartbeat_interval)
+            await self.loop.sleep(self.cfg.heartbeat_interval)
 
     async def unlock(self, node_name: str, lock_key: str) -> None:
         n = await self._enter(node_name)
@@ -991,11 +991,11 @@ class Cluster:
             raise SimError("compacted", f"{rev} is a future revision")
         await self.propose(leader.name, "compact", rev)
         if physical:
-            await sleep(10 * MS)
+            await self.loop.sleep(10 * MS)
 
     async def defrag(self, node_name: str) -> None:
         n = await self._enter(node_name)
-        await sleep(self.loop.rng.randint(50 * MS, 200 * MS))
+        await self.loop.sleep(self.loop.rng.randint(50 * MS, 200 * MS))
         n.log_line("defragmented")
 
     # ---- membership ---------------------------------------------------------

@@ -1,0 +1,131 @@
+"""Same-seed columnar/dict equivalence fuzz (r6 tentpole guard).
+
+The interpreter records every history twice: the dict op stream (the
+serialization- and replay-compatible representation) and the typed SoA
+columns (core/history.py OpColumns) the hot checker paths consume. This
+suite pins the contract between the two:
+
+- materializing the columns back to ops is *bit-identical* to the dict
+  stream — index, time, process, type, f, value, and every extra key —
+  for every workload, with and without nemeses;
+- the composed checker reaches the same verdicts whether it is handed
+  the dual-backed recorded history (columnar fast paths engaged) or a
+  dict-only copy (reference paths);
+- the flagship columnar pipeline — ``split_by_key`` into the batched
+  register packer — runs without a single dict materialization
+  (``History.dict_materializations`` stays 0).
+"""
+
+import json
+
+import pytest
+
+from jepsen_etcd_tpu.checkers.core import Noop
+from jepsen_etcd_tpu.compose import etcd_test
+from jepsen_etcd_tpu.core.history import History
+from jepsen_etcd_tpu.runner.test_runner import run_test
+
+#: one config per workload; nemesis mixes mirror the cross-run battery
+#: at small time limits so the whole file stays tier-1-fast
+CONFIGS = {
+    "register-nemesis": dict(workload="register",
+                             nodes=["n1", "n2", "n3"],
+                             time_limit=5, rate=200, seed=11,
+                             nemesis=["kill", "partition"],
+                             nemesis_interval=2),
+    "set-nemesis": dict(workload="set", time_limit=4, rate=200, seed=19,
+                        nemesis=["pause", "clock"], nemesis_interval=2),
+    "append-nemesis": dict(workload="append", nodes=["n1", "n2", "n3"],
+                           time_limit=4, rate=150, seed=5,
+                           nemesis=["partition"], nemesis_interval=2),
+    "watch": dict(workload="watch", time_limit=4, rate=150, seed=9),
+    "lock": dict(workload="lock", nodes=["n1", "n2", "n3"],
+                 time_limit=5, rate=100, seed=13, nemesis=["kill"],
+                 nemesis_interval=2),
+    "wr": dict(workload="wr", nodes=["n1", "n2", "n3"],
+               time_limit=4, rate=200, seed=21),
+}
+
+
+def _record(tmp_path, name):
+    """Run the config's sim; returns (test, composed_checker, history).
+
+    The run itself uses a Noop checker — the composed checker is
+    exercised explicitly on both representations by the test."""
+    cfg = dict(CONFIGS[name])
+    cfg["store_base"] = str(tmp_path)
+    cfg["no_telemetry"] = True
+    test = etcd_test(cfg)
+    checker = test["checker"]
+    test["checker"] = Noop()
+    out = run_test(test)
+    return test, checker, out["history"]
+
+
+def _strip(result) -> str:
+    return json.dumps(result, sort_keys=True, default=repr)
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_columns_equivalent_and_verdicts_agree(tmp_path, name):
+    test, checker, h = _record(tmp_path, name)
+    cols = h.columns
+    assert cols is not None, "recorded history lost its columns"
+    assert len(cols) == len(h)
+
+    # 1) column materialization is bit-identical to the dict stream
+    back = History.from_columns(cols).ops
+    assert len(back) == len(h.ops)
+    for a, b in zip(h.ops, back):
+        assert dict(a) == dict(b), (dict(a), dict(b))
+
+    # 2) composed checker: columnar fast paths vs dict-only reference
+    res_cols = checker.check(test, h)
+    h_dict = History(list(h.ops))          # no columns attached
+    assert h_dict.columns is None
+    res_dict = checker.check(test, h_dict)
+    assert _strip(res_cols) == _strip(res_dict)
+    assert res_cols["valid?"] == res_dict["valid?"]
+
+
+def test_columnar_register_pipeline_no_dict_materialization(tmp_path):
+    """Tier-1 regression guard (r6 acceptance): the columnar checker
+    path — split_by_key into the batched SoA register packer — must not
+    round-trip through dict ops at all."""
+    from jepsen_etcd_tpu.ops import wgl
+
+    cfg = dict(workload="register", nodes=["n1", "n2", "n3"],
+               time_limit=20, rate=0, ops_per_key=60, seed=17,
+               snapshot_count=100_000, store_base=str(tmp_path),
+               no_telemetry=True)
+    test = etcd_test(cfg)
+    test["checker"] = Noop()
+    h = run_test(test)["history"]
+    assert h.columns is not None
+
+    h2 = History.from_columns(h.columns)   # column-only view
+    History.dict_materializations = 0
+    subs = h2.split_by_key()
+    assert subs, "register run produced no keyed subhistories"
+    packs = wgl.pack_register_histories_batched(subs)
+    assert History.dict_materializations == 0, \
+        "columnar pipeline materialized dict ops"
+    assert set(packs) == set(subs)
+    assert all(p.ok for p in packs.values()), \
+        {k: p.reason for k, p in packs.items() if not p.ok}
+
+    # the packs are the SAME packs the dict path produces
+    ref = wgl.pack_register_histories_batched(
+        {k: History(list(s.ops)) for k, s in h.split_by_key().items()})
+    import dataclasses
+    import numpy as np
+    for k, p in packs.items():
+        q = ref[k]
+        wgl.ensure_frames(p)
+        wgl.ensure_frames(q)
+        for fld in dataclasses.fields(type(p)):
+            x, y = getattr(p, fld.name), getattr(q, fld.name)
+            if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+                assert np.array_equal(x, y), (k, fld.name)
+            else:
+                assert x == y, (k, fld.name, x, y)

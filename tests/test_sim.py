@@ -253,3 +253,52 @@ def test_gather_child_cancel_does_not_kill_gatherer(loop):
         assert t2.done and t2.result() == "ok"
 
     loop.run_coro(main())
+
+
+def test_cancelled_timer_tombstones_compact(loop):
+    # Regression (r6): cancelling timers leaves tombstones in the heap;
+    # once they dominate (and exceed COMPACT_FLOOR) the loop compacts
+    # instead of letting the heap grow without bound.
+    n = 4 * SimLoop.COMPACT_FLOOR
+    timers = [loop.call_later(i + 1, lambda: None) for i in range(n)]
+    assert len(loop._heap) == n
+    # cancel all but a few: tombstones dominate -> compaction fires
+    for t in timers[:-4]:
+        t.cancel()
+    assert all(t.cancelled for t in timers[:-4])
+    # compaction fired (repeatedly): the heap stays bounded by the
+    # floor instead of holding all n-4 tombstones, and every non-live
+    # entry still in it is accounted for in _dead
+    assert len(loop._heap) - 4 == loop._dead
+    assert loop._dead <= 2 * SimLoop.COMPACT_FLOOR
+    assert len(loop._heap) < n // 2
+    # heap invariant survived compaction: survivors still fire in order
+    fired = []
+    for j, t in enumerate(timers[-4:]):
+        t._entry[2] = lambda j=j: fired.append(j)
+    loop.run()
+    assert fired == [0, 1, 2, 3]
+
+
+def test_cancel_below_floor_keeps_tombstones(loop):
+    # Below COMPACT_FLOOR a filter+heapify costs more than popping the
+    # dead entries during run(); cancel() must leave them in place.
+    timers = [loop.call_later(i + 1, lambda: None) for i in range(8)]
+    for t in timers[:6]:
+        t.cancel()
+    assert len(loop._heap) == 8 and loop._dead == 6
+    loop.run()                  # drains tombstones without firing them
+    assert loop._dead == 0 and not loop._heap
+
+
+def test_same_instant_batch_drains_in_seq_order(loop):
+    # The batched same-instant drain must preserve (time, seq) order,
+    # including entries a callback pushes at the SAME instant.
+    fired = []
+    loop.call_at(5, lambda: fired.append("a"))
+    loop.call_at(5, lambda: (fired.append("b"),
+                             loop.call_at(5, lambda: fired.append("d"))))
+    loop.call_at(5, lambda: fired.append("c"))
+    loop.run()
+    assert fired == ["a", "b", "c", "d"]
+    assert loop.now == 5
