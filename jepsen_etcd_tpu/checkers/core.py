@@ -20,6 +20,24 @@ class Checker:
         raise NotImplementedError
 
 
+def stream_hint(test: Any, history, name: str):
+    """Fetch a streaming-precomputed artifact (runner/stream.py installs
+    ``test["_stream"]`` = {name: (artifact, n_rows), ...}) if — and only
+    if — it provably covers THIS history: the feed consumed exactly
+    ``len(history)`` rows and the history still carries the columns the
+    artifact was extracted from. Returns the artifact or None; hints
+    are pure reuse, never a correctness dependency — a None simply
+    means the checker recomputes from scratch."""
+    hint = test.get("_stream") if isinstance(test, dict) else None
+    if not hint or getattr(history, "columns", None) is None:
+        return None
+    got = hint.get(name)
+    if got is None or got[1] != len(history):
+        return None
+    telemetry.current().counter(f"stream.{name}_reuse")
+    return got[0]
+
+
 def _merge_valid(vals: list) -> Any:
     """jepsen merge-valid: false < unknown < true."""
     if any(v is False for v in vals):

@@ -34,9 +34,10 @@ import numpy as np
 logger = logging.getLogger("jepsen_etcd_tpu.checkers")
 
 from ...core.history import History
-from ...ops.closure import closure_levels_lazy
+from ...ops.closure import EdgeAccumulator, closure_levels_lazy
 
 WW, WR, RW, RT = "ww", "wr", "rw", "realtime"
+_ET_INDEX = {WW: 0, WR: 1, RW: 2}
 
 #: certificate-enumeration bounds per anomaly class: enough to show every
 #: independent cycle in practice without letting one big SCC turn the
@@ -123,16 +124,38 @@ def _bfs_path(adj: dict[int, list], src: int, dst: int) -> Optional[list]:
 
 
 class DepGraph:
-    """Sparse per-type edges over n transaction nodes."""
+    """Sparse per-type edges over n transaction nodes.
+
+    Edges are held in an :class:`~...ops.closure.EdgeAccumulator` —
+    chunked int32 buffers instead of a set of tuples — so the streaming
+    path can accumulate edges incrementally without a per-edge Python
+    object footprint. ``finalize()`` yields the sorted-unique per-type
+    ``[E, 2]`` arrays, which are both the kernel input and (row order ==
+    ``sorted(set)``) what every host-side consumer below iterates."""
 
     def __init__(self, n: int):
         self.n = n
-        self.edges: dict[str, set] = {WW: set(), WR: set(), RW: set()}
+        self._acc = EdgeAccumulator(len(_ET_INDEX))
+        self._sets: Optional[dict] = None  # lazy, certificates only
         self.rt: Optional[np.ndarray] = None  # dense [n, n] bool
 
     def add(self, etype: str, i: int, j: int) -> None:
-        if i != j:
-            self.edges[etype].add((i, j))
+        self._acc.add(_ET_INDEX[etype], i, j)
+        self._sets = None
+
+    def _arrays(self) -> list[np.ndarray]:
+        return self._acc.finalize()
+
+    @property
+    def edges(self) -> dict[str, set]:
+        """Per-type edge sets, materialized on demand (certificate
+        recovery and membership tests only — the hot paths use the
+        finalized arrays directly)."""
+        if self._sets is None:
+            arrs = self._arrays()
+            self._sets = {et: set(map(tuple, arrs[ti].tolist()))
+                          for et, ti in _ET_INDEX.items()}
+        return self._sets
 
     def set_realtime(self, invoke_idx: np.ndarray,
                      complete_idx: np.ndarray) -> None:
@@ -151,20 +174,21 @@ class DepGraph:
 
     def _dense(self, *etypes: str) -> np.ndarray:
         a = np.zeros((self.n, self.n), dtype=bool)
+        arrs = self._arrays()
         for et in etypes:
             if et == RT:
                 if self.rt is not None:
                     a |= self.rt
                 continue
-            es = self.edges[et]
-            if es:
-                idx = np.array(sorted(es))
+            idx = arrs[_ET_INDEX[et]]
+            if len(idx):
                 a[idx[:, 0], idx[:, 1]] = True
         return a
 
     def _adj_lists(self, *etypes: str) -> dict[int, list]:
         adj: dict[int, list] = {}
         seen = set()
+        arrs = self._arrays()
         for et in etypes:
             if et == RT:
                 if self.rt is not None:
@@ -173,7 +197,7 @@ class DepGraph:
                             seen.add((i, j))
                             adj.setdefault(int(i), []).append(int(j))
                 continue
-            for i, j in sorted(self.edges[et]):
+            for i, j in arrs[_ET_INDEX[et]].tolist():
                 if (i, j) not in seen:
                     seen.add((i, j))
                     adj.setdefault(i, []).append(j)
@@ -216,9 +240,7 @@ class DepGraph:
         lvl_mask = np.array(
             [[et in ets for et in et_order] + [RT in ets]
              for ets in levels])
-        et_edges = [np.array(sorted(self.edges[et]),
-                             np.int32).reshape(-1, 2)
-                    for et in et_order]
+        et_edges = [self._arrays()[_ET_INDEX[et]] for et in et_order]
         rt_vecs = getattr(self, "_rt_vecs", None) if use_rt else None
         reach_fn, on_cycle = closure_levels_lazy(
             et_edges, lvl_mask, self.n, rt_vecs,
@@ -284,7 +306,9 @@ class DepGraph:
         truncated_classes: list = []
         add = recs.extend
 
-        ww, wr, rw = self.edges[WW], self.edges[WR], self.edges[RW]
+        # anchor lists come straight from the finalized arrays: already
+        # lexicographically sorted, so anchored()'s sorted() is a no-op
+        ww, wr, rw = (list(map(tuple, e.tolist())) for e in et_edges)
         if on_cycle[0].any():
             add(anchored("G0", ww, need=0))
         if on_cycle[1].any():

@@ -11,7 +11,7 @@ padded tensor and vmap over it (SURVEY §2.3).
 from __future__ import annotations
 
 from ..core.history import History
-from .core import Checker, _merge_valid
+from .core import Checker, _merge_valid, stream_hint
 
 
 class Independent(Checker):
@@ -20,6 +20,15 @@ class Independent(Checker):
 
     def check(self, test, history, opts=None) -> dict:
         h = history if isinstance(history, History) else History(history)
+        # streaming reuse: the feed's per-key register packs were
+        # extracted from this exact op stream (row-count + columns
+        # guard in stream_hint). Validated HERE — the only place the
+        # parent history is visible — and handed down via opts so the
+        # batch checker can skip its own pack pass key-for-key.
+        packs = stream_hint(test, h, "register_packs")
+        if packs is not None:
+            opts = dict(opts or {})
+            opts["_stream_packs"] = packs
         # one pass over the parent history builds every per-key
         # subhistory (the per-key subhistory() loop re-scans the full
         # history once per key — O(K * N) host time the batched packer

@@ -99,7 +99,7 @@ def _increment_of(prev: list, vals: list) -> Optional[list]:
     return ins
 
 
-def analyze(history) -> dict:
+def analyze(history, _scan=None) -> dict:
     """Element-lifecycle analysis; see module docstring for outcomes.
 
     Int-valued workloads (every real set workload) run the columnar
@@ -107,10 +107,16 @@ def analyze(history) -> dict:
     first-true, lost/stale via suffix comparisons — the per-read set
     arithmetic of the sweep becomes a handful of matrix reductions.
     Anything else falls back to the reference sweep; both produce
-    identical results (differentially tested in tests/test_set.py)."""
+    identical results (differentially tested in tests/test_set.py).
+
+    ``_scan`` is a precomputed event-scan tuple (a finished
+    :class:`ColumnScan` fed incrementally by the streaming runner); it
+    replaces the scan pass only — the vectorized tail still runs here,
+    so the result is bit-identical to the post-hoc path by
+    construction."""
     h = history if isinstance(history, History) else History(history)
     try:
-        return _analyze_columnar(h)
+        return _analyze_columnar(h, _scan=_scan)
     except _NonColumnar:
         return _analyze_reference(h)
 
@@ -312,86 +318,130 @@ def _scan_ops(h: History):
     return adds, r_ri, r_rt, r_ok, views, payloads, anchor, mono
 
 
+class ColumnScan:
+    """Resumable form of the columnar event scan: ``feed`` OpColumns
+    chunks as generation proceeds (the streaming set path — the
+    incremental half of the running-max presence pipeline); ``finish``
+    returns the same (adds, r_ri, r_rt, r_ok, views, payloads, anchor,
+    mono) tuple one pass over the complete columns produces. Open
+    invocations carry their (index, time) across chunk boundaries, and
+    chain detection's ``prev`` view spans chunks unchanged, so chunked
+    feeding is bit-identical to the one-shot scan (``_scan_columns``
+    is now just the one-shot wrapper). ``feed`` raises _NonColumnar
+    exactly where the one-shot scan would; the streaming driver treats
+    that as stream invalidation while post-hoc callers fall back to
+    the reference sweep as before."""
+
+    __slots__ = ("adds", "r_ri", "r_rt", "r_ok", "views", "payloads",
+                 "anchor", "prev", "mono", "last_ok", "open_by",
+                 "n_rows")
+
+    def __init__(self):
+        self.adds: dict = {}
+        self.r_ri: list = []
+        self.r_rt: list = []
+        self.r_ok: list = []
+        self.views: list = []
+        self.payloads: list = []
+        self.anchor: list = []
+        self.prev: list = []
+        self.mono = True
+        self.last_ok = None
+        self.open_by: dict = {}   # process code -> (invoke idx, time)
+        self.n_rows = 0           # total column rows consumed
+
+    def feed(self, cols) -> None:
+        self.n_rows += len(cols)
+        adds = self.adds
+        r_ri, r_rt, r_ok = self.r_ri, self.r_rt, self.r_ok
+        views, payloads, anchor = self.views, self.payloads, self.anchor
+        prev = self.prev
+        mono = self.mono
+        last_ok = self.last_ok
+        open_by = self.open_by
+        tc = cols.type_code.tolist()
+        pr = cols.proc.tolist()
+        fcl = cols.f_code.tolist()
+        ft = cols.f_table
+        idx = cols.index.tolist()
+        tm = cols.time.tolist()
+        vals_col = cols.values
+        pt = cols.proc_table
+        try:
+            for i, t in enumerate(tc):
+                p = pr[i]
+                if t == 0:
+                    open_by[p] = (idx[i], tm[i])
+                    inv = None
+                else:
+                    inv = open_by.pop(p, None)
+                f = ft[fcl[i]]
+                if f == "add":
+                    if p < 0 and not isinstance(pt[-1 - p], int):
+                        continue
+                    x = vals_col[i]
+                    if type(x) is not int:
+                        raise _NonColumnar
+                    rec = adds.get(x)
+                    if rec is None:
+                        rec = adds[x] = [None, None, None, 0]
+                    if t == 0:
+                        rec[0] = idx[i]
+                    else:
+                        rec[1] = TYPE_NAMES[t]
+                        if t == 1 and rec[2] is None:
+                            rec[2] = idx[i]    # first :ok completion
+                            rec[3] = tm[i] or 0
+                elif f == "read" and t == 1:
+                    v = vals_col[i]
+                    if v is None or (p < 0
+                                     and not isinstance(pt[-1 - p], int)):
+                        continue
+                    vals = v if type(v) is list else list(v)
+                    lp = len(prev)
+                    if views and len(vals) >= lp and vals[:lp] == prev:
+                        payloads.append(vals[lp:])
+                        anchor.append(False)
+                    else:
+                        inc = _increment_of(prev, vals) if views else None
+                        if inc is not None:
+                            payloads.append(inc)
+                            anchor.append(False)
+                        else:
+                            payloads.append(vals)
+                            anchor.append(True)
+                    prev = vals
+                    views.append(vals)
+                    oki = idx[i]
+                    if last_ok is not None and oki < last_ok:
+                        mono = False
+                    last_ok = oki
+                    r_ri.append(inv[0] if inv is not None else oki)
+                    r_rt.append((inv[1] if inv is not None
+                                 else tm[i]) or 0)
+                    r_ok.append(oki)
+        finally:
+            self.prev = prev
+            self.mono = mono
+            self.last_ok = last_ok
+
+    def finish(self):
+        return (self.adds, self.r_ri, self.r_rt, self.r_ok, self.views,
+                self.payloads, self.anchor, self.mono)
+
+
 def _scan_columns(cols):
     """_scan_ops over SoA columns (core/history.py OpColumns): the same
     event scan fed from typed arrays and intern tables — no per-op dict
     access, and read invocations pair by an inline per-process walk
     instead of History.pairs (which would materialize dict ops on a
-    column-only history)."""
-    adds: dict = {}
-    r_ri: list = []
-    r_rt: list = []
-    r_ok: list = []
-    views: list = []
-    payloads: list = []
-    anchor: list = []
-    prev: list = []
-    mono = True
-    last_ok = None
-    tc = cols.type_code.tolist()
-    pr = cols.proc.tolist()
-    fcl = cols.f_code.tolist()
-    ft = cols.f_table
-    idx = cols.index.tolist()
-    tm = cols.time.tolist()
-    vals_col = cols.values
-    pt = cols.proc_table
-    open_by: dict = {}       # process code -> invoke row
-    for i, t in enumerate(tc):
-        p = pr[i]
-        if t == 0:
-            open_by[p] = i
-            inv_row = None
-        else:
-            inv_row = open_by.pop(p, None)
-        f = ft[fcl[i]]
-        if f == "add":
-            if p < 0 and not isinstance(pt[-1 - p], int):
-                continue
-            x = vals_col[i]
-            if type(x) is not int:
-                raise _NonColumnar
-            rec = adds.get(x)
-            if rec is None:
-                rec = adds[x] = [None, None, None, 0]
-            if t == 0:
-                rec[0] = idx[i]
-            else:
-                rec[1] = TYPE_NAMES[t]
-                if t == 1 and rec[2] is None:
-                    rec[2] = idx[i]        # first :ok completion
-                    rec[3] = tm[i] or 0
-        elif f == "read" and t == 1:
-            v = vals_col[i]
-            if v is None or (p < 0 and not isinstance(pt[-1 - p], int)):
-                continue
-            vals = v if type(v) is list else list(v)
-            lp = len(prev)
-            if views and len(vals) >= lp and vals[:lp] == prev:
-                payloads.append(vals[lp:])
-                anchor.append(False)
-            else:
-                inc = _increment_of(prev, vals) if views else None
-                if inc is not None:
-                    payloads.append(inc)
-                    anchor.append(False)
-                else:
-                    payloads.append(vals)
-                    anchor.append(True)
-            prev = vals
-            views.append(vals)
-            oki = idx[i]
-            if last_ok is not None and oki < last_ok:
-                mono = False
-            last_ok = oki
-            r_ri.append(idx[inv_row] if inv_row is not None else oki)
-            r_rt.append((tm[inv_row] if inv_row is not None
-                         else tm[i]) or 0)
-            r_ok.append(oki)
-    return adds, r_ri, r_rt, r_ok, views, payloads, anchor, mono
+    column-only history). One-shot wrapper of :class:`ColumnScan`."""
+    s = ColumnScan()
+    s.feed(cols)
+    return s.finish()
 
 
-def _analyze_columnar(h: History) -> dict:
+def _analyze_columnar(h: History, _scan=None) -> dict:
     """Vectorized analyze(): element x read presence matrix in numpy.
 
     The host floor for set histories is the read payload: ~24k ops
@@ -415,11 +465,14 @@ def _analyze_columnar(h: History) -> dict:
     elements, out-of-order ok indices — retry in full mode with one
     row per read, which is bit-identical to the sweep by the
     differential fuzz in tests/test_set.py."""
-    cols = getattr(h, "columns", None)
-    if cols is not None:
-        scan = _scan_columns(cols)
+    if _scan is not None:
+        scan = _scan
     else:
-        scan = _scan_ops(h)
+        cols = getattr(h, "columns", None)
+        if cols is not None:
+            scan = _scan_columns(cols)
+        else:
+            scan = _scan_ops(h)
     adds, r_ri, r_rt, r_ok, views, payloads, anchor, mono = scan
     nR = len(r_ok)
 
@@ -700,7 +753,14 @@ class SetFull(Checker):
         self.linearizable = linearizable
 
     def check(self, test, history, opts=None) -> dict:
-        res = analyze(history)
+        # streaming reuse: the runner installs a finished incremental
+        # event scan on the test when it consumed the WHOLE history the
+        # checker is now handed (row-count guard re-checked here); the
+        # vectorized tail still runs below, so verdicts stay
+        # bit-identical to the post-hoc path by construction
+        from .core import stream_hint
+        res = analyze(history, _scan=stream_hint(test, history,
+                                                 "set_scan"))
         if res["read-count"] == 0:
             valid: Any = "unknown"
         elif res["lost-count"] or res["duplicated-count"] or (

@@ -356,9 +356,21 @@ class TPULinearizableChecker(Checker):
         # degrade-don't-crash on Mosaic failures all apply to this
         # production path exactly as inside check_packed_batch.
         from ..ops import wgl_mxu
-        with telemetry.current().span("wgl.pack-batch",
-                                      keys=len(big_keys)):
-            packed = pack_batch({k: subhistories[k] for k in big_keys})
+        packs_hint = (opts or {}).get("_stream_packs")
+        if packs_hint is not None and \
+                all(k in packs_hint for k in big_keys):
+            # streaming reuse: the feed already packed every key from
+            # the same op stream (per-key pack independence is pinned by
+            # tests/test_wgl_batch_pack.py, so selecting this subset is
+            # exactly what pack_batch would have produced)
+            telemetry.current().counter("stream.pack_reuse",
+                                        len(big_keys))
+            packed = {k: packs_hint[k] for k in big_keys}
+        else:
+            with telemetry.current().span("wgl.pack-batch",
+                                          keys=len(big_keys)):
+                packed = pack_batch({k: subhistories[k]
+                                     for k in big_keys})
         packs = [packed[k] for k in big_keys]
         outs: list = [None] * len(big_keys)
         if self.f_max is None:

@@ -99,6 +99,26 @@ def build_parser() -> argparse.ArgumentParser:
                        help="skip writing store/<run>/telemetry.jsonl "
                             "(phase/checker spans and kernel counters "
                             "are on by default)")
+        s.add_argument("--stream", action="store_true",
+                       help="online chunked checking: feed recorded op "
+                            "columns to checker front-ends while "
+                            "generation runs; verdicts stay "
+                            "bit-identical to post-hoc")
+        s.add_argument("--stream-chunk-ops", type=int, default=1024,
+                       help="recorded ops per streamed chunk "
+                            "(default 1024)")
+        s.add_argument("--soak", action="store_true",
+                       help="sliding-window soak against ONE long-lived "
+                            "cluster (--client-type http/grpc): each "
+                            "window is generated, streamed, checked and "
+                            "released before the next, so memory stays "
+                            "bounded indefinitely")
+        s.add_argument("--soak-windows", type=int, default=0,
+                       help="number of soak windows (0 = run until "
+                            "interrupted)")
+        s.add_argument("--soak-window-s", type=float, default=None,
+                       help="per-window time limit in seconds "
+                            "(default: --time-limit)")
         s.add_argument("--test-count", type=int, default=1)
         s.add_argument("--only-workloads-expected-to-pass",
                        action="store_true")
@@ -172,6 +192,11 @@ def opts_from_args(args) -> dict:
         "debug": args.debug,
         "tcpdump": args.tcpdump,
         "no_telemetry": getattr(args, "no_telemetry", False),
+        "stream": getattr(args, "stream", False),
+        "stream_chunk_ops": getattr(args, "stream_chunk_ops", 1024),
+        "soak": getattr(args, "soak", False),
+        "soak_windows": getattr(args, "soak_windows", 0),
+        "soak_window_s": getattr(args, "soak_window_s", None),
         "store_base": args.store,
     }
 
@@ -242,6 +267,24 @@ def main(argv=None) -> int:
     enable_compile_cache()
     if args.command == "test":
         opts = opts_from_args(args)
+        if opts.get("soak"):
+            from .runner.test_runner import run_soak
+
+            def _print_window(summary, _out):
+                print(json.dumps(summary))
+                return None
+
+            try:
+                out = run_soak(opts, on_window=_print_window)
+            except KeyboardInterrupt:
+                # interactive stop is the normal exit for
+                # --soak-windows 0; the finally in run_soak already
+                # tore the shared cluster down
+                print(json.dumps({"soak": "interrupted"}))
+                return 0
+            print(json.dumps({"soak-windows": out["count"],
+                              "valid?": out["valid?"]}))
+            return 0 if out["valid?"] is True else 1
         ok = True
         for i in range(args.test_count):
             opts["seed"] = args.seed + i

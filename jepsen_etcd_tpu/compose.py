@@ -50,6 +50,19 @@ def default_opts() -> dict:
         "debug": False,
         "no_telemetry": False,          # every run writes telemetry.jsonl
                                         # unless opted out (--no-telemetry)
+        "stream": False,                # --stream: online chunked checking
+                                        # (runner/stream.py) overlapped
+                                        # with generation
+        "stream_chunk_ops": 1024,       # ops per streamed chunk
+        "key_offset": 0,                # first register key id (soak
+                                        # windows rotate it so a retained
+                                        # cluster never re-serves a
+                                        # checked key)
+        "soak": False,                  # --soak: sliding-window run
+                                        # against one long-lived cluster
+        "soak_windows": 0,              # 0 = run until interrupted
+        "soak_window_s": None,          # per-window time limit (None:
+                                        # --time-limit)
         "version": "sim-3.5.6",         # etcd.clj:206-207 (pinned: the sim
                                         # has exactly one "binary")
     }

@@ -182,6 +182,61 @@ if HAVE_JAX:
         return _closure_device(a, iters)
 
 
+class EdgeAccumulator:
+    """Incremental typed-edge accumulation for the Elle dependency
+    graph: ``add(t, i, j)`` appends into per-type growing int32 chunk
+    buffers (amortized O(1), no Python set-of-tuples — the set was the
+    memory floor on long streamed histories), and ``finalize()``
+    returns per-type sorted-unique ``[E, 2]`` int32 arrays, row-for-row
+    identical to ``np.array(sorted(set_of_pairs))`` — exactly the
+    compact edge lists :func:`closure_levels_lazy` ships to the device.
+    Feeding may resume after a finalize (the streaming/soak path
+    accumulates edges chunk by chunk and snapshots between windows);
+    finalize is cached until the next add."""
+
+    CHUNK = 4096
+
+    def __init__(self, n_types: int):
+        self.n_types = n_types
+        self._bufs: list[list] = [[] for _ in range(n_types)]
+        self._fill = [0] * n_types
+        self._final = None
+
+    def add(self, t: int, i: int, j: int) -> None:
+        if i == j:
+            return
+        bufs = self._bufs[t]
+        f = self._fill[t]
+        if not bufs or f == len(bufs[-1]):
+            bufs.append(np.empty((self.CHUNK, 2), dtype=np.int32))
+            f = 0
+        cur = bufs[-1]
+        cur[f, 0] = i
+        cur[f, 1] = j
+        self._fill[t] = f + 1
+        self._final = None
+
+    def __len__(self) -> int:
+        """Raw (pre-dedup) edge count across all types."""
+        return sum((len(b) - 1) * self.CHUNK + self._fill[t]
+                   if (b := self._bufs[t]) else 0
+                   for t in range(self.n_types))
+
+    def finalize(self) -> list:
+        if self._final is None:
+            out = []
+            for t in range(self.n_types):
+                bufs = self._bufs[t]
+                if not bufs:
+                    out.append(np.zeros((0, 2), dtype=np.int32))
+                    continue
+                rows = np.concatenate(bufs[:-1]
+                                      + [bufs[-1][:self._fill[t]]])
+                out.append(np.unique(rows, axis=0))
+            self._final = out
+        return self._final
+
+
 def _closure_numpy(a: np.ndarray) -> tuple:
     n = a.shape[-1]
     r = a | np.eye(n, dtype=bool)[None]

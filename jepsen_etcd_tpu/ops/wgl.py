@@ -663,111 +663,153 @@ def _rows_from_columns(cols):
     return procs, types, fs, cols.values
 
 
+class _KeyExtract:
+    """Resumable form of the merged extraction pass over ONE key's raw
+    ops: invoke/completion pairing (history_entries), required-op
+    classification, and register-language field extraction fused into a
+    single loop, feedable in row chunks (the streaming packer's
+    per-chunk drain) or in one shot (:func:`_extract_key_columns`).
+
+    The state carried between ``feed`` calls — open invocations (each
+    held as ``(pos, f, value)`` so the completion may land in a later
+    chunk), the running int-process row position, and the required-op
+    count — is exactly the loop state of the one-shot pass, so chunked
+    feeding is bit-identical to one pass over the concatenation.
+    ``finish`` runs the history-end sweep (still-open ops become
+    indefinite updates) and must be called exactly once."""
+
+    __slots__ = ("lists", "ilists", "open_by", "pos", "n_req")
+
+    def __init__(self, lists=None, ilists=None):
+        self.lists = lists if lists is not None \
+            else tuple([] for _ in range(8))
+        self.ilists = ilists if ilists is not None \
+            else tuple([] for _ in range(6))
+        self.open_by: dict = {}
+        self.pos = 0
+        self.n_req = 0
+
+    def feed(self, rows) -> None:
+        """Consume one (procs, types, fs, vals) row-chunk. Raises
+        _Delegate / TypeError / ValueError exactly where the one-shot
+        pass would; the caller owns rollback of shared lists."""
+        procs, types, fs, vals = rows
+        inv_l, ret_l, f_l, ver_l, v1t_l, v1v_l, v2t_l, v2v_l = self.lists
+        ilists = self.ilists
+        open_by = self.open_by
+        pos = self.pos
+        n_req = self.n_req
+        lo_ver, hi_ver = -(2 ** 29), 2 ** 29
+        try:
+            for i, proc in enumerate(procs):
+                if not isinstance(proc, int):
+                    continue
+                pos += 1
+                t = types[i]
+                if t == "invoke":
+                    open_by[proc] = (pos, fs[i], vals[i])
+                    continue
+                got = open_by.pop(proc, None)
+                if got is None or t == "fail":
+                    continue
+                if t == "ok":
+                    f = got[1]
+                    ev = vals[i]
+                    # 2-unpacks mirror the reference exactly (it
+                    # unpacks any 2-iterable); failures surface as
+                    # TypeError/ValueError, which the caller converts
+                    # to delegation — and the reference then re-raises
+                    # the identical error
+                    if f == "read":
+                        fc = READ
+                        if ev is None:
+                            rv = rval = None
+                        else:
+                            rv, rval = ev
+                        if rval is None:
+                            t1, x1 = 0, 0  # wildcard: asserts nothing
+                        elif type(rval) is int:
+                            t1, x1 = 2, rval
+                        else:
+                            raise _Delegate
+                        t2 = x2 = 0
+                    elif f == "write":
+                        fc = WRITE
+                        rv, wval = ev
+                        if wval is None:
+                            t1, x1 = 1, 0
+                        elif type(wval) is int:
+                            t1, x1 = 2, wval
+                        else:
+                            raise _Delegate
+                        t2 = x2 = 0
+                    elif f == "cas":
+                        fc = CAS
+                        rv, (old, new) = ev
+                        if old is None:
+                            t1, x1 = 1, 0
+                        elif type(old) is int:
+                            t1, x1 = 2, old
+                        else:
+                            raise _Delegate
+                        if new is None:
+                            t2, x2 = 1, 0
+                        elif type(new) is int:
+                            t2, x2 = 2, new
+                        else:
+                            raise _Delegate
+                    else:
+                        raise _Delegate  # unsupported f: per-key msg
+                    if rv is None:
+                        ver = NO_ASSERT
+                    elif type(rv) is int and lo_ver < rv < hi_ver:
+                        ver = rv
+                    else:
+                        raise _Delegate  # as_version semantics / range
+                    inv_l.append(got[0])
+                    ret_l.append(pos)
+                    f_l.append(fc)
+                    ver_l.append(ver)
+                    v1t_l.append(t1)
+                    v1v_l.append(x1)
+                    v2t_l.append(t2)
+                    v2v_l.append(x2)
+                    n_req += 1
+                elif t == "info":
+                    f = got[1]
+                    if f != "read":    # indefinite update
+                        _classify_info(got[0], f, got[2], ilists)
+                    # info reads are dropped up front (assert nothing)
+                else:
+                    open_by[proc] = got  # ad-hoc type: leave op open
+        finally:
+            self.pos = pos
+            self.n_req = n_req
+
+    def finish(self) -> int:
+        """History end: ops still open are indefinite, like :info
+        completions. Returns the key's required-op count."""
+        for ppos, f, val in self.open_by.values():
+            if f != "read":
+                _classify_info(ppos, f, val, self.ilists)
+        return self.n_req
+
+
 def _extract_key_columns(rows, lists, ilists):
-    """ONE merged pass over a key's raw ops: invoke/completion pairing
-    (history_entries), required-op classification, and register-language
-    field extraction fused into a single loop. ``rows`` is the
-    (procs, types, fs, vals) parallel-list form of the ops — built by
-    _rows_from_ops (dict histories) or _rows_from_columns (SoA-backed
-    histories, no dict round-trip).
+    """ONE merged pass over a key's raw ops — the one-shot form of
+    :class:`_KeyExtract`. ``rows`` is the (procs, types, fs, vals)
+    parallel-list form of the ops — built by _rows_from_ops (dict
+    histories) or _rows_from_columns (SoA-backed histories, no dict
+    round-trip).
     Appends required-op columns to the shared flat ``lists`` (and
     indefinite updates to ``ilists``); returns the number of required
     ops appended. Raises _Delegate on anything the vectorized phase
     can't express bit-identically: non-int payload values (interning
     needs Python == semantics), non-int or out-of-range version
     assertions, unsupported fs, and malformed value shapes."""
-    procs, types, fs, vals = rows
-    inv_l, ret_l, f_l, ver_l, v1t_l, v1v_l, v2t_l, v2v_l = lists
-    open_by: dict = {}
-    pos = 0
-    n_req = 0
-    lo_ver, hi_ver = -(2 ** 29), 2 ** 29
-    for i, proc in enumerate(procs):
-        if not isinstance(proc, int):
-            continue
-        pos += 1
-        t = types[i]
-        if t == "invoke":
-            open_by[proc] = (pos, i)
-            continue
-        got = open_by.pop(proc, None)
-        if got is None or t == "fail":
-            continue
-        if t == "ok":
-            f = fs[got[1]]
-            ev = vals[i]
-            # 2-unpacks mirror the reference exactly (it unpacks any
-            # 2-iterable); failures surface as TypeError/ValueError,
-            # which the caller converts to delegation — and the
-            # reference then re-raises the identical error
-            if f == "read":
-                fc = READ
-                if ev is None:
-                    rv = rval = None
-                else:
-                    rv, rval = ev
-                if rval is None:
-                    t1, x1 = 0, 0          # wildcard: asserts nothing
-                elif type(rval) is int:
-                    t1, x1 = 2, rval
-                else:
-                    raise _Delegate
-                t2 = x2 = 0
-            elif f == "write":
-                fc = WRITE
-                rv, wval = ev
-                if wval is None:
-                    t1, x1 = 1, 0
-                elif type(wval) is int:
-                    t1, x1 = 2, wval
-                else:
-                    raise _Delegate
-                t2 = x2 = 0
-            elif f == "cas":
-                fc = CAS
-                rv, (old, new) = ev
-                if old is None:
-                    t1, x1 = 1, 0
-                elif type(old) is int:
-                    t1, x1 = 2, old
-                else:
-                    raise _Delegate
-                if new is None:
-                    t2, x2 = 1, 0
-                elif type(new) is int:
-                    t2, x2 = 2, new
-                else:
-                    raise _Delegate
-            else:
-                raise _Delegate       # unsupported f: per-key message
-            if rv is None:
-                ver = NO_ASSERT
-            elif type(rv) is int and lo_ver < rv < hi_ver:
-                ver = rv
-            else:
-                raise _Delegate       # as_version semantics / range
-            inv_l.append(got[0])
-            ret_l.append(pos)
-            f_l.append(fc)
-            ver_l.append(ver)
-            v1t_l.append(t1)
-            v1v_l.append(x1)
-            v2t_l.append(t2)
-            v2v_l.append(x2)
-            n_req += 1
-        elif t == "info":
-            f = fs[got[1]]
-            if f != "read":           # indefinite update
-                _classify_info(got[0], f, vals[got[1]], ilists)
-            # info reads are dropped up front (assert nothing)
-        else:
-            open_by[proc] = got       # ad-hoc type: leave the op open
-    # ops still open at history end: indefinite, like :info completions
-    for ppos, inv_i in open_by.values():
-        f = fs[inv_i]
-        if f != "read":
-            _classify_info(ppos, f, vals[inv_i], ilists)
-    return n_req
+    st = _KeyExtract(lists, ilists)
+    st.feed(rows)
+    return st.finish()
 
 
 def _intern_values_batched(key_of, ridx, v1t, v1v, v2t, v2v,
@@ -933,6 +975,22 @@ def pack_register_histories_batched(subhistories: dict,
         seg_I_l.append(len(ipos_l) - imark)
     if not fast_keys:
         return out
+    return _pack_batched_tail(fast_keys, seg_R_l, seg_I_l,
+                              lists, ilists, out)
+
+
+def _pack_batched_tail(fast_keys, seg_R_l, seg_I_l, lists, ilists,
+                       out: dict) -> dict:
+    """The vectorized phase of :func:`pack_register_histories_batched`:
+    given the flat per-key-contiguous extraction lists (keys in the
+    order of ``fast_keys``, required ops sorted by invoke within each
+    key's segment), run interning, dead-value merge, window geometry,
+    ceilings, rank compression and per-key Packed assembly. Shared by
+    the one-shot batched packer and the streaming packer
+    (:class:`PackStream`), which must feed IDENTICAL flat lists for the
+    same history — that is the whole bit-identity argument."""
+    (inv_l, ret_l, f_l, ver_l, v1t_l, v1v_l, v2t_l, v2v_l) = lists
+    (ipos_l, if_l, i1t_l, i1v_l, i2t_l, i2v_l) = ilists
 
     Kf = len(fast_keys)
     seg_R = np.array(seg_R_l, dtype=np.int64)
@@ -1172,6 +1230,90 @@ def pack_register_histories_batched(subhistories: dict,
     return out
 
 
+class PackStream:
+    """Streaming front-end of the batched register packer: ``feed``
+    columnar op-stream chunks (core/history.py OpColumns — e.g. the
+    ``ColumnsBuilder.take_chunk`` drain) while generation proceeds;
+    ``finish()`` returns the same ``{key: Packed}`` dict
+    :func:`pack_register_histories_batched` produces over the completed
+    history's per-key split.
+
+    Bit-identity argument: the per-op extraction pass is chunk-resumable
+    (:class:`_KeyExtract` carries the one-shot loop's exact state), keys
+    accumulate in first-seen order (matching ``split_by_key``'s group
+    order), and ``finish`` concatenates each key's lists into the same
+    per-key-contiguous flat arrays before running the SAME vectorized
+    tail (:func:`_pack_batched_tail`). The tail itself cannot run per
+    chunk — suffix-min version ceilings, dead-value merges and info
+    symmetry classes all depend on the history's future — so only the
+    per-op Python pass overlaps generation; that is the host-packing
+    half the cost model in PERF.md §2 attributes to extraction.
+
+    Any key the columnar path can't express (reference-delegation
+    semantics, malformed shapes) invalidates the whole stream: ``ok``
+    flips False, further feeds no-op, and ``finish`` returns None — the
+    checker then packs post-hoc exactly as before. Streaming is a pure
+    reuse hint, never a correctness dependency."""
+
+    def __init__(self):
+        self._keys: list = []
+        self._st: dict = {}
+        self.ok = True
+        #: total column rows consumed (ALL events, keyed or not) — the
+        #: consumer's guard that the stream saw the complete history
+        self.n_rows = 0
+        self.chunks = 0
+
+    def feed(self, cols) -> None:
+        if cols is None or not self.ok:
+            return
+        self.n_rows += len(cols)
+        self.chunks += 1
+        try:
+            for key, sub in cols.split_by_key().items():
+                st = self._st.get(key)
+                if st is None:
+                    st = self._st[key] = _KeyExtract()
+                    self._keys.append(key)
+                st.feed(_rows_from_columns(sub))
+        except (_Delegate, TypeError, ValueError):
+            self.ok = False
+
+    def finish(self) -> Optional[dict]:
+        if not self.ok:
+            return None
+        out: dict = {}
+        fast_keys: list = []
+        seg_R_l: list = []
+        seg_I_l: list = []
+        lists = tuple([] for _ in range(8))
+        ilists = tuple([] for _ in range(6))
+        try:
+            for key in self._keys:
+                st = self._st[key]
+                n_req = st.finish()
+                if n_req == 0:
+                    # no required ops: trivially linearizable, before
+                    # any indefinite op is even considered (mirrors the
+                    # batched packer's early out)
+                    out[key] = Packed(ok=True, R=0)
+                    continue
+                fast_keys.append(key)
+                seg_R_l.append(n_req)
+                seg_I_l.append(len(st.ilists[0]))
+                for dst, src in zip(lists, st.lists):
+                    dst.extend(src)
+                for dst, src in zip(ilists, st.ilists):
+                    dst.extend(src)
+        except (_Delegate, TypeError, ValueError):
+            self.ok = False
+            return None
+        if fast_keys:
+            _pack_batched_tail(fast_keys, seg_R_l, seg_I_l,
+                               lists, ilists, out)
+        return out
+
+
 # ---------------------------------------------------------------------------
 # the kernel
 
@@ -1403,6 +1545,21 @@ def _kernel_resume_jitted(f_max: int, w: int):
     return jax.jit(run)
 
 
+@functools.lru_cache(maxsize=None)
+def _kernel_budget_jitted(f_max: int, w: int):
+    """Wave-budgeted twin of :func:`_kernel_resume_jitted`: same resume
+    signature plus a traced ``k_stop`` wave ceiling, so one compile per
+    (f_max, w) rung serves every chunk size the streaming driver picks
+    (the budget is data, not shape)."""
+    import jax
+
+    def run(tables, R, I, k_stop, k0, d0, w0, i0, v0, n0):
+        return _wgl_loop(tables, R, I, f_max, w,
+                         (k0, d0, w0, i0, v0, n0), k_stop=k_stop)
+
+    return jax.jit(run)
+
+
 def _wgl_kernel(tables: dict, R, I, f_max: int = F_MAX, w: int = W):
     """Run the wave loop from the initial state. tables hold the
     [R_pad, ...] arrays; R (number of required ops) and I (number of
@@ -1415,15 +1572,23 @@ def _wgl_kernel(tables: dict, R, I, f_max: int = F_MAX, w: int = W):
     return _wgl_loop(tables, R, I, f_max, w, None)
 
 
-def _wgl_loop(tables: dict, R, I, f_max: int, w: int, init0):
+def _wgl_loop(tables: dict, R, I, f_max: int, w: int, init0,
+              k_stop=None):
     import jax.numpy as jnp
     from jax import lax
+
+    # k_stop (traced) budgets the waves run THIS call — the streaming
+    # check_prefix API pauses there and resumes later with identical
+    # semantics; None (every non-streaming caller) keeps the exact
+    # R + I + 1 exhaustion bound and compiles the same trace as before
+    lim = (R + I + 1) if k_stop is None \
+        else jnp.minimum(k_stop, R + I + 1)
 
     def body(carry):
         k, dvec, wvec, ivec, vvec, n_alive, overflow, accepted, peak = carry
         # vmap-safety guard: under vmap, while_loop runs until ALL batch
         # elements finish; finished elements must be no-ops.
-        active = (~accepted) & (n_alive > 0) & (~overflow) & (k < R + I + 1)
+        active = (~accepted) & (n_alive > 0) & (~overflow) & (k < lim)
         out_d, out_w, out_i, out_v, n_new, acc_now = _expand(
             dvec, wvec, ivec, vvec, tables, R, I, w, f_max)
         ovf_now = (n_new > f_max) & (~acc_now)
@@ -1441,7 +1606,7 @@ def _wgl_loop(tables: dict, R, I, f_max: int, w: int, init0):
 
     def cond(carry):
         k, _, _, _, _, n_alive, overflow, accepted, _ = carry
-        return (~accepted) & (n_alive > 0) & (~overflow) & (k < R + I + 1)
+        return (~accepted) & (n_alive > 0) & (~overflow) & (k < lim)
 
     nw = w // 32
     ni = tables["c_inc"].shape[-1] if "c_inc" in tables else 0
@@ -1957,3 +2122,152 @@ def _check_packed_impl(p: Packed, f_max: Optional[int] = None,
             "ops": p.R, "info-ops": p.I, "rungs": rungs,
             "engine": "jnp-ladder",
             **({} if valid else {"stuck-at-depth": int(k)})}
+
+
+# ---------------------------------------------------------------------------
+# chunked frontier resume (streaming / soak)
+
+
+class FrontierState:
+    """Opaque resumable cursor for :func:`check_prefix`: the device
+    tables, the frozen pre-expansion frontier, the cumulative wave
+    counter, the current ladder rung and the run accounting. ``done``
+    flips once the search concludes; ``result`` then holds the same
+    dict the one-shot ladder (:func:`check_packed`) produces."""
+
+    __slots__ = ("p", "tables", "R_", "I_", "ladder", "rung_i", "k",
+                 "frontier", "peak", "rungs", "waves_run", "done",
+                 "result", "spill")
+
+    def __init__(self):
+        self.done = False
+        self.result = None
+        self.waves_run = 0
+
+
+def check_prefix(p: Packed, state: Optional[FrontierState] = None,
+                 max_waves: int = 64,
+                 spill: bool = True) -> FrontierState:
+    """Chunked form of the WGL ladder: advance the BFS by at most
+    ``max_waves`` waves and return the (possibly finished) frontier
+    state — ``check_prefix(packed, state) -> state``, the streaming /
+    soak monitor API. Call with ``state=None`` to start; poll
+    ``state.done`` / ``state.result``.
+
+    Exactness: the wave budget only chooses WHERE the loop pauses —
+    frontier contents, rung escalations (each counted on the
+    ``stream.resume_rungs`` telemetry counter), spill hand-off and the
+    final verdict dict are bit-identical to ``check_packed``'s jnp
+    ladder for every budget, including ``max_waves`` larger than the
+    whole search (tests/test_stream.py pins this across budgets).
+    The MXU fused path is not attempted here — chunked pausing is a
+    host-driven loop by construction; production one-shot checks keep
+    their fused routing."""
+    import jax.numpy as jnp
+
+    if state is None:
+        state = FrontierState()
+        state.p = p
+        state.spill = spill
+        if not p.ok:
+            state.done = True
+            state.result = {"valid?": "unknown", "reason": p.reason,
+                            "blowup": p.blowup}
+            return state
+        if p.R == 0:
+            state.done = True
+            state.result = {"valid?": True, "waves": 0}
+            return state
+        ladder = LADDER
+        if p.w == W_MAX:
+            # same rung cap as _check_packed_impl: W=128 compiles are
+            # expensive and top out at the DFS/spill hand-off anyway
+            ladder = [f for f in ladder
+                      if f <= F_MAX and f != 256] or [ladder[0]]
+        state.ladder = ladder
+        _c_pad, ni, _i_tab = info_dims(p)
+        state.tables = {k: jnp.asarray(v)
+                        for k, v in pad_tables(p, bucket(p.R)).items()}
+        state.R_, state.I_ = jnp.int32(p.R), jnp.int32(p.I)
+        state.rung_i = 0
+        nw = p.w // 32
+        d0 = np.full((ladder[0],), SENTINEL_D, dtype=np.int32)
+        d0[0] = 0
+        w0 = np.full((ladder[0], nw), SENTINEL_W, dtype=np.uint32)
+        w0[0] = 0
+        i0 = np.zeros((ladder[0], ni), dtype=np.uint32)
+        v0 = np.full((ladder[0],), SENTINEL_V, dtype=np.int32)
+        v0[0] = NONE_VAL
+        state.frontier = (jnp.asarray(d0), jnp.asarray(w0),
+                          jnp.asarray(i0), jnp.asarray(v0),
+                          jnp.int32(1))
+        state.k = jnp.int32(0)
+        state.peak = 1
+        state.rungs = 1
+    if state.done:
+        return state
+    p = state.p
+    dvec, wvec, ivec, vvec, n_alive = state.frontier
+    k_before = int(state.k)
+    k_stop = jnp.int32(k_before + max(1, max_waves))
+    valid, overflow, k, peak, frontier = _kernel_budget_jitted(
+        state.ladder[state.rung_i], p.w)(
+            state.tables, state.R_, state.I_, k_stop,
+            state.k, dvec, wvec, ivec, vvec, n_alive)
+    state.peak = max(state.peak, int(peak))
+    state.k, state.frontier = k, frontier
+    state.waves_run += int(k) - k_before
+    if bool(overflow):
+        if state.rung_i + 1 < len(state.ladder):
+            # climb one rung: pad the frozen pre-expansion frontier in
+            # place, exactly like the one-shot ladder — earlier waves
+            # are never redone (module contract)
+            state.rung_i += 1
+            state.rungs += 1
+            telemetry.current().counter("stream.resume_rungs")
+            f_next = state.ladder[state.rung_i]
+            dvec, wvec, ivec, vvec, n_alive = frontier
+            grow = f_next - dvec.shape[0]
+            state.frontier = (
+                jnp.concatenate([dvec, jnp.full(
+                    (grow,), SENTINEL_D, dtype=jnp.int32)]),
+                jnp.concatenate([wvec, jnp.full(
+                    (grow, wvec.shape[1]), SENTINEL_W,
+                    dtype=jnp.uint32)]),
+                jnp.concatenate([ivec, jnp.zeros(
+                    (grow, ivec.shape[1]), dtype=jnp.uint32)]),
+                jnp.concatenate([vvec, jnp.full(
+                    (grow,), SENTINEL_V, dtype=jnp.int32)]),
+                n_alive)
+            return state
+        # past the top rung: spill (complete last resort) or hand the
+        # frozen frontier back, mirroring check_packed's contract
+        state.done = True
+        if state.spill:
+            out = spill_packed(p, state.tables, state.frontier,
+                               int(state.k))
+            out["peak-frontier"] = max(state.peak,
+                                       out.get("peak-frontier", 0))
+            out["rungs"] = state.rungs
+            out.setdefault("engine", "jnp-ladder")
+            state.result = out
+        else:
+            state.result = {
+                "valid?": "unknown", "overflow": True,
+                "reason": "frontier overflow past the top rung",
+                "peak-frontier": state.peak, "ops": p.R,
+                "info-ops": p.I, "rungs": state.rungs,
+                "engine": "jnp-ladder",
+                "_resume": (state.tables, state.frontier,
+                            int(state.k))}
+        return state
+    valid = bool(valid)
+    k_i = int(state.k)
+    if valid or int(frontier[4]) == 0 or k_i >= p.R + p.I + 1:
+        state.done = True
+        state.result = {
+            "valid?": valid, "waves": k_i, "peak-frontier": state.peak,
+            "ops": p.R, "info-ops": p.I, "rungs": state.rungs,
+            "engine": "jnp-ladder",
+            **({} if valid else {"stuck-at-depth": k_i})}
+    return state

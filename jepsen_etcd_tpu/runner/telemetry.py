@@ -22,10 +22,14 @@ comparable with one reader):
     {"kind": "event",   "name": ..., "t": ..., "attrs": {...}}
 
 Span-name conventions: ``phase:<name>`` for run phases (setup,
-generate, teardown, check, save), ``checker:<name>`` for one composed
-checker's pass, everything else dotted by subsystem (``wgl.check_packed``,
-``mxu.launch``, ``closure.device``). Times are ``time.monotonic()``
-wall seconds — telemetry measures host/device cost, not virtual time.
+generate, stream-finalize, teardown, check, save), ``checker:<name>``
+for one composed checker's pass, everything else dotted by subsystem
+(``wgl.check_packed``, ``mxu.launch``, ``closure.device``; streamed
+runs add per-chunk ``stream.chunk`` dispatch spans, ``stream.finalize``
+consumer spans, and the ``stream.{chunks,flushed_events,resume_rungs,
+backlog_peak,pack_reuse,*_reuse}`` counters from runner/stream.py).
+Times are ``time.monotonic()`` wall seconds — telemetry measures
+host/device cost, not virtual time.
 
 Deep code (ops/, checkers/) reaches the recorder through ``current()``,
 which returns a no-op ``NullTelemetry`` outside a run, so kernels and

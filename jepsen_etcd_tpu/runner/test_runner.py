@@ -53,6 +53,29 @@ def _make_telemetry(test: dict, store_dir: str):
     return tel
 
 
+def _make_stream(test: dict):
+    """The run's streaming check feed (``--stream``), or None. Always
+    clears a stale ``_stream`` hint map first: hints are one run's
+    artifacts and must never leak into a re-used test dict."""
+    test.pop("_stream", None)
+    if not test.get("stream"):
+        return None
+    from .stream import StreamFeed
+    return StreamFeed(test, chunk_ops=test.get("stream_chunk_ops") or 0)
+
+
+def _finish_stream(stream, history) -> None:
+    """Drain + join the feed and install its reuse hints; its own span
+    so run reports separate residual finalize cost from phase:check."""
+    if stream is None:
+        return
+    with telemetry.current().span("phase:stream-finalize",
+                                  ops=len(history)) as sp:
+        hints = stream.finish(history)
+        sp.set(chunks=stream.chunks,
+               hints=sorted(k for k in hints if k != "stats"))
+
+
 class ClientPool:
     """Per-thread workload clients with jepsen's lifecycle: a worker whose
     process crashes (:info) gets a fresh client on its next op."""
@@ -162,6 +185,7 @@ def run_test(test: dict) -> dict:
         db = test["db"]
         pool = ClientPool(test)
         nemesis_obj = test.get("nemesis")
+        stream = _make_stream(test)
 
         async def invoke(process: int, op: Op) -> Op:
             client = pool.client_for(process)
@@ -185,7 +209,8 @@ def run_test(test: dict) -> dict:
             with tel_now.span("phase:generate") as sp:
                 h = await interpret(test, test["generator"], invoke,
                                     test["concurrency"],
-                                    nemesis_invoke=nemesis_invoke)
+                                    nemesis_invoke=nemesis_invoke,
+                                    stream=stream)
                 sp.set(ops=len(h))
             _tally_generate(tel_now, h, wall_time.time() - g0)
             with tel_now.span("phase:teardown"):
@@ -203,6 +228,7 @@ def run_test(test: dict) -> dict:
             return h
 
         history = loop.run_coro(main())
+        _finish_stream(stream, history)
         sim_seconds = loop.now / 1e9
         # leak scan AFTER the run, recorded into results rather than
         # thrown — a leak must not destroy the run's artifacts (they're
@@ -298,6 +324,7 @@ def run_test_live(test: dict) -> dict:
         db = test["db"]
         pool = ClientPool(test)
         nemesis_obj = test.get("nemesis")
+        stream = _make_stream(test)
 
         async def invoke(process: int, op: Op) -> Op:
             client = pool.client_for(process)
@@ -321,7 +348,8 @@ def run_test_live(test: dict) -> dict:
             with tel_now.span("phase:generate") as sp:
                 h = await interpret(test, test["generator"], invoke,
                                     test["concurrency"],
-                                    nemesis_invoke=nemesis_invoke)
+                                    nemesis_invoke=nemesis_invoke,
+                                    stream=stream)
                 sp.set(ops=len(h))
             _tally_generate(tel_now, h, wall_time.time() - g0)
             with tel_now.span("phase:teardown"):
@@ -339,6 +367,7 @@ def run_test_live(test: dict) -> dict:
             return h
 
         history = loop.run_coro(main())
+        _finish_stream(stream, history)
         sim_seconds = loop.now / 1e9
         task_leak = None
         try:
@@ -360,3 +389,121 @@ def run_test_live(test: dict) -> dict:
         telemetry.set_current(None)
         if tel is not None:
             tel.close()
+
+
+class _SharedDb:
+    """One live cluster across soak windows: the inner db's setup runs
+    on the first window only, per-window teardown is a no-op, and
+    ``close()`` performs the real teardown after the last window.
+    Everything else (client_url, fault delivery, log collection)
+    forwards to the inner control plane."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self._ready = False
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    async def setup(self, test: dict) -> None:
+        if not self._ready:
+            await self.inner.setup(test)
+            self._ready = True
+
+    async def teardown(self, test: dict) -> None:
+        pass
+
+    def close(self) -> None:
+        if self._ready:
+            self.inner.stop_all()
+
+
+#: per-window register key-space stride: windows re-use the retained
+#: cluster state, so a key checked in window w must never be generated
+#: again in window w+1 (stale state would read as a false
+#: linearizability violation)
+SOAK_KEY_STRIDE = 100_000
+
+
+def run_soak(opts: dict, on_window=None) -> dict:
+    """Sliding-window soak: check a long-running local cluster window
+    by window with bounded memory (ISSUE 8 tentpole (c)).
+
+    One shared control plane (``_SharedDb``) outlives every window;
+    each window composes a fresh test with a rotated seed and register
+    key offset, runs the normal live pipeline (streaming enabled by
+    default so hints overlap generation), and is reduced to a summary
+    dict immediately — the window's history is released before the
+    next window generates, so memory is bounded by one window.
+
+    ``soak_windows`` = 0 runs until interrupted (the CLI's soak mode);
+    ``on_window(summary, out)`` sees each window's full result before
+    release and may return truthy to stop the loop.
+    """
+    from ..compose import etcd_test
+    base = dict(opts)
+    base.pop("soak", None)
+    windows_target = int(base.pop("soak_windows", 0) or 0)
+    window_s = base.pop("soak_window_s", None)
+    if base.get("client_type") not in ("http", "grpc"):
+        raise ValueError(
+            "soak mode checks a long-lived live cluster; use "
+            "--client-type http/grpc with --db local (the fake-etcd "
+            "stub works) or --db live")
+    if base.get("db_mode") == "local" and not base.get("etcd_data_dir"):
+        # windows >= 1 discard their freshly composed LocalDb; pin one
+        # data root so the discards never mkdtemp roots of their own
+        import tempfile
+        base["etcd_data_dir"] = tempfile.mkdtemp(prefix="jepsen-soak-")
+    # soak always streams: the window's pack/scan artifacts are ready
+    # the moment generation ends, so per-window checking stays a
+    # vectorized finalize (setdefault is not enough — the CLI threads
+    # an explicit stream=False through opts_from_args)
+    if not base.get("stream"):
+        base["stream"] = True
+    shared = None
+    summaries: list[dict] = []
+    all_valid = True
+    w = 0
+    try:
+        while windows_target == 0 or w < windows_target:
+            o = dict(base)
+            if window_s:
+                o["time_limit"] = window_s
+            o["key_offset"] = (int(base.get("key_offset") or 0)
+                               + w * SOAK_KEY_STRIDE)
+            o["seed"] = int(base.get("seed") or 0) + w
+            test = etcd_test(o)
+            if shared is None:
+                shared = _SharedDb(test["db"])
+            test["db"] = shared
+            test["name"] = f"{test['name']}-soak-w{w}"
+            out = run_test_live(test)
+            summary = {"window": w, "valid?": out["valid?"],
+                       "ops": len(out["history"]),
+                       "dir": out["dir"],
+                       "wall-seconds": out["wall-seconds"],
+                       "key_offset": o["key_offset"],
+                       "seed": o["seed"]}
+            try:
+                import resource
+                summary["rss_peak_kb"] = resource.getrusage(
+                    resource.RUSAGE_SELF).ru_maxrss
+            except Exception:
+                pass
+            summaries.append(summary)
+            all_valid = all_valid and out["valid?"] is True
+            logger.info("soak window %d: valid?=%s (%d ops)",
+                        w, out["valid?"], summary["ops"])
+            stop = on_window(summary, out) if on_window is not None \
+                else None
+            # release the window: the summary is all that survives
+            out = None
+            test = None
+            w += 1
+            if stop:
+                break
+    finally:
+        if shared is not None:
+            shared.close()
+    return {"valid?": all_valid, "windows": summaries, "count": w}

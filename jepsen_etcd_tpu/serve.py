@@ -39,8 +39,22 @@ code{background:#f4f4f4;padding:1px 4px}
 #: run-phase display order and bar colors (phases map keys come from
 #: runner/telemetry.py's ``phase:<name>`` spans)
 _PHASES = (("setup", "#9ab8d8"), ("generate", "#8fc98f"),
-           ("teardown", "#d8d8d8"), ("check", "#e0a848"),
-           ("save", "#b8a0d0"))
+           ("stream-finalize", "#6fc4bc"), ("teardown", "#d8d8d8"),
+           ("check", "#e0a848"), ("save", "#b8a0d0"))
+
+
+def _overlap_ratio(phases: dict, counters: dict):
+    """End-to-end-over-generation ratio for streamed runs: how close
+    checking came to free. (generate + stream-finalize + check) /
+    generate — 1.0 means verification added no wall time beyond
+    generation. None for runs that never streamed a chunk."""
+    if not counters.get("stream.chunks"):
+        return None
+    gen = phases.get("generate")
+    if not isinstance(gen, (int, float)) or gen <= 0:
+        return None
+    extra = sum(phases.get(k) or 0 for k in ("stream-finalize", "check"))
+    return (gen + extra) / gen
 
 
 def _badge(v) -> str:
@@ -95,6 +109,9 @@ def _run_rows(store_base: str) -> list[dict]:
                      "phases": tel.get("phases") or {},
                      "gen_rate": (tel.get("counters") or {})
                      .get("generate.ops_per_s"),
+                     "overlap": _overlap_ratio(
+                         tel.get("phases") or {},
+                         tel.get("counters") or {}),
                      "signature": _failure_signature(results)})
     rows.sort(key=lambda r: r["mtime"], reverse=True)
     return rows
@@ -193,17 +210,24 @@ def aggregate_html(store_base: str) -> str:
     # -- per-run phase breakdown bars ----------------------------------------
     out.append("<h2>Phase breakdown (wall time per run)</h2>"
                "<table><tr><th>run</th><th>valid?</th>"
-               "<th>gen ops/s</th><th>phases</th></tr>")
+               "<th>gen ops/s</th><th>e2e/gen</th><th>phases</th></tr>")
     for r in rows:
         rate = r.get("gen_rate")
         rate_td = (f"<td>{rate:,.0f}</td>"
                    if isinstance(rate, (int, float))
                    else "<td class='dim'>—</td>")
+        ov = r.get("overlap")
+        # streamed runs only: how much wall time verification added on
+        # top of generation (1.00x = checking came free)
+        ov_td = (f"<td title='(generate + stream-finalize + check) / "
+                 f"generate'>{ov:.2f}&times;</td>"
+                 if isinstance(ov, (int, float))
+                 else "<td class='dim'>—</td>")
         out.append(
             f'<tr><td><a href="/{quote(r["dir"])}/">'
             f'{html.escape(r["dir"])}</a></td>'
             f"<td>{_badge(r['valid?'])}</td>"
-            f"{rate_td}"
+            f"{rate_td}{ov_td}"
             f"<td>{_phase_bar(r['phases'])}</td></tr>")
     out.append("</table><p class='dim'>"
                + " ".join(f"<span class='bar' style='width:12px;"

@@ -71,6 +71,9 @@ def workload(opts: dict) -> dict:
     conc = opts.get("concurrency", 2 * n)
     group = max(1, min(2 * n, conc))
     readers = max(1, group // 2)
+    # soak windows rotate key_offset so a retained live cluster never
+    # re-serves a key an earlier window already wrote and checked
+    k0 = int(opts.get("key_offset") or 0)
     return {
         "client": RegisterClient(),
         "checker": independent_checker(compose({
@@ -83,7 +86,7 @@ def workload(opts: dict) -> dict:
         })),
         "generator": independent.concurrent_generator(
             group,
-            range(10 ** 12),
+            range(k0, 10 ** 12),
             lambda k: limit(opts.get("ops_per_key", 200),
                             reserve(readers, r, mix([w, cas])))),
     }

@@ -41,6 +41,14 @@ def test_dry_set_cell():
     assert cell["attempts"] > 0
 
 
+def test_dry_streaming_cell():
+    res = run_dry("--cell", "streaming_overlap")
+    cell = res["dry"]["streaming_overlap"]
+    assert cell["ok"] is True and cell["check"] == "_dry_streaming"
+    assert cell["chunks"] >= 2
+    assert cell["ops"] > 0
+
+
 def test_dry_rejects_unknown_cell():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     out = subprocess.run(

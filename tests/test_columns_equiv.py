@@ -88,6 +88,139 @@ def test_columns_equivalent_and_verdicts_agree(tmp_path, name):
     assert res_cols["valid?"] == res_dict["valid?"]
 
 
+#: chunk sizes swept by the streaming fuzz; None = whole history in one
+#: flush (chunk_ops larger than the run)
+CHUNK_SIZES = (1, 64, 4096, None)
+
+
+def _replay_stream(test, h, chunk_ops):
+    """Re-feed the recorded op stream through a fresh ColumnsBuilder +
+    StreamFeed at the given chunk size — the identical column stream the
+    live interpreter would have produced, so ONE sim run fuzzes every
+    chunk size. Returns the validated hint map."""
+    from jepsen_etcd_tpu.core.history import ColumnsBuilder
+    from jepsen_etcd_tpu.runner.stream import StreamFeed
+
+    carrier = {"workload": test.get("workload")}
+    feed = StreamFeed(carrier, chunk_ops=chunk_ops or (len(h) + 1))
+    builder = ColumnsBuilder()
+    feed.attach(builder)
+    for op in h.ops:
+        builder.append(op)
+        feed.on_record()
+    hints = feed.finish(h)
+    assert feed.error is None
+    assert hints["stats"]["rows"] == len(h)
+    if chunk_ops == 1:
+        assert hints["stats"]["chunks"] == len(h)
+    elif chunk_ops is None:
+        assert hints["stats"]["chunks"] == 1
+    return hints
+
+
+def _assert_artifact_equal(a, b, path="artifact"):
+    """Deep equality over the hint artifacts (nested dicts / tuples /
+    dataclass packs / numpy arrays) — json-dumps would silently
+    truncate large arrays."""
+    import dataclasses
+    import numpy as np
+
+    if isinstance(a, dict):
+        assert isinstance(b, dict) and set(a) == set(b), path
+        for k in a:
+            _assert_artifact_equal(a[k], b[k], f"{path}[{k!r}]")
+    elif isinstance(a, (list, tuple)):
+        assert type(a) is type(b) and len(a) == len(b), path
+        for i, (x, y) in enumerate(zip(a, b)):
+            _assert_artifact_equal(x, y, f"{path}[{i}]")
+    elif isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        assert np.array_equal(a, b), path
+    elif dataclasses.is_dataclass(a):
+        assert type(a) is type(b), path
+        for fld in dataclasses.fields(type(a)):
+            _assert_artifact_equal(getattr(a, fld.name),
+                                   getattr(b, fld.name),
+                                   f"{path}.{fld.name}")
+    else:
+        assert a == b, (path, a, b)
+
+
+@pytest.mark.parametrize("name", ["register-nemesis", "set-nemesis"])
+def test_streaming_verdicts_bit_identical_across_chunk_sizes(
+        tmp_path, name):
+    """ISSUE 8 fuzz: for every chunk size, the checker handed streamed
+    hints reaches a verdict BIT-identical to the post-hoc pass, and the
+    hint artifacts themselves are deterministic across chunk sizes
+    (chunk boundaries choose pause points, never results)."""
+    hint_key = ("register_packs" if name.startswith("register")
+                else "set_scan")
+    test, checker, h = _record(tmp_path, name)
+    test.pop("_stream", None)
+    posthoc = _strip(checker.check(test, h))
+    artifacts = {}
+    for cs in CHUNK_SIZES:
+        hints = _replay_stream(test, h, cs)
+        assert hint_key in hints, (cs, sorted(hints))
+        test["_stream"] = hints
+        try:
+            streamed = _strip(checker.check(test, h))
+        finally:
+            test.pop("_stream", None)
+        assert streamed == posthoc, f"verdict diverged at chunk={cs}"
+        artifacts[cs] = hints[hint_key]
+    base_cs = CHUNK_SIZES[0]
+    for cs in CHUNK_SIZES[1:]:
+        _assert_artifact_equal(artifacts[cs], artifacts[base_cs],
+                               f"chunk={cs} vs chunk={base_cs}")
+
+
+def test_streaming_register_pipeline_no_dict_materialization(tmp_path):
+    """ISSUE 8 tier-1 guard: the streaming register path — chunked
+    PackStream feeding plus hint validation — performs zero
+    History.dict_materializations, and its packs are the batched
+    packer's packs bit for bit. (The small-key DFS fallback materializes
+    dicts by design on BOTH streamed and post-hoc runs; the streaming
+    contract covers the feed/pack/hint pipeline.)"""
+    from jepsen_etcd_tpu.checkers.core import stream_hint
+    from jepsen_etcd_tpu.core.history import ColumnsBuilder
+    from jepsen_etcd_tpu.ops import wgl
+
+    cfg = dict(workload="register", nodes=["n1", "n2", "n3"],
+               time_limit=20, rate=0, ops_per_key=60, seed=17,
+               snapshot_count=100_000, store_base=str(tmp_path),
+               no_telemetry=True)
+    test = etcd_test(cfg)
+    test["checker"] = Noop()
+    h = run_test(test)["history"]
+    assert h.columns is not None
+
+    History.dict_materializations = 0
+    ps = wgl.PackStream()
+    builder = ColumnsBuilder()
+    for i, op in enumerate(h.ops, 1):   # dict ops already exist: the
+        builder.append(op)              # replayed feed sees the same
+        if i % 256 == 0:                # column chunks the live
+            ps.feed(builder.take_chunk())  # interpreter drains
+    ps.feed(builder.take_chunk())
+    packs = ps.finish()
+    assert ps.ok and packs is not None
+    assert ps.n_rows == len(h)
+
+    # hint validation on a column-only history is dict-free too
+    h2 = History.from_columns(h.columns)
+    test["_stream"] = {"stats": {}, "register_packs": (packs, ps.n_rows)}
+    assert stream_hint(test, h2, "register_packs") is packs
+    assert History.dict_materializations == 0, \
+        "streaming register path materialized dict ops"
+
+    ref = wgl.pack_register_histories_batched(h2.split_by_key())
+    assert set(packs) == set(ref)
+    for k in ref:
+        wgl.ensure_frames(packs[k])
+        wgl.ensure_frames(ref[k])
+    _assert_artifact_equal(packs, ref, "streamed packs vs batched")
+
+
 def test_columnar_register_pipeline_no_dict_materialization(tmp_path):
     """Tier-1 regression guard (r6 acceptance): the columnar checker
     path — split_by_key into the batched SoA register packer — must not
