@@ -1018,7 +1018,32 @@ DRY_CHECKS = {"register_100": _dry_register,
               "register_10k": _dry_register}
 
 
+#: modules whose lint cleanliness gates a bench round: the register
+#: kernel driver and the set checker are exactly the code BENCH rounds
+#: time, and a determinism/columnar/dispatch regression there makes
+#: the numbers wrong before they're slow
+LINT_GATED = ("jepsen_etcd_tpu/ops/wgl.py",
+              "jepsen_etcd_tpu/checkers/set_full.py")
+
+
+def _lint_gate() -> None:
+    """Run graftlint over the bench-critical modules; raises on any
+    non-suppressed finding. Pure-AST, a few ms — cheap insurance that
+    a cell isn't about to time a dispatch storm or a dict round-trip."""
+    import os
+    from jepsen_etcd_tpu.lint import run_lint
+    report = run_lint(paths=[os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), p) for p in LINT_GATED])
+    if report.errors:
+        lines = "\n".join(f"  {f.location()}: {f.rule}: {f.message}"
+                          for f in report.errors)
+        raise SystemExit(f"bench lint gate failed "
+                         f"({len(report.errors)} finding(s)):\n{lines}")
+    note(f"lint gate: {report.files} modules clean")
+
+
 def run_dry(cell: str | None) -> int:
+    _lint_gate()
     names = [cell] if cell else sorted(set(DRY_CHECKS))
     out = {}
     for name in names:
@@ -1072,6 +1097,7 @@ def main() -> int:
     enable_compile_cache()
     if args.dry:
         return run_dry(args.cell)
+    _lint_gate()
     tel = _bench_telemetry()
     if args.cell and args.cell != "register_10k":
         fn = dict(CELLS)[args.cell]

@@ -40,9 +40,11 @@ def latency_points(h: History) -> dict[str, list[tuple[float, float, str]]]:
                                       TYPE_NAMES[tc[comp]]))
         return dict(out)
     out = defaultdict(list)
+    # graftlint: ignore[COL002] dict fallback for loaded/legacy histories
     for op in h.client_ops():
         if not op.is_invoke:
             continue
+        # graftlint: ignore[COL002] dict fallback for loaded/legacy histories
         comp = h.completion(op)
         if comp is None:
             continue
@@ -60,9 +62,32 @@ def quantiles(xs: list[float], qs=(0.5, 0.95, 0.99, 1.0)) -> dict:
 
 
 def nemesis_bands(h: History) -> list[dict]:
-    """[{f, start_s, end_s}] windows of nemesis activity."""
-    bands = []
+    """[{f, start_s, end_s}] windows of nemesis activity.
+
+    Columnar path: nemesis rows are the non-int processes (interned
+    negative in ``cols.proc``); read f/time straight from the typed
+    arrays instead of materializing per-op dicts via nemesis_ops()."""
+    bands: list = []
     open_at: dict = {}
+    cols = getattr(h, "columns", None)
+    if cols is not None:
+        tc = cols.type_code.tolist()
+        pr = cols.proc.tolist()
+        pt = cols.proc_table
+        tm = cols.time.tolist()
+        fcl = cols.f_code.tolist()
+        ft = cols.f_table
+        for i, p in enumerate(pr):
+            if p >= 0 or isinstance(pt[-1 - p], int):
+                continue  # client row
+            f = ft[fcl[i]]
+            if tc[i] == 0:  # invoke
+                open_at[f] = tm[i]
+            elif f in open_at:
+                bands.append({"f": f, "start": open_at.pop(f) / SECOND,
+                              "end": tm[i] / SECOND})
+        return bands
+    # graftlint: ignore[COL002] dict fallback for loaded/legacy histories
     for op in h.nemesis_ops():
         if op.is_invoke:
             open_at[op.f] = op["time"]
@@ -94,14 +119,15 @@ class Perf(Checker):
             duration = (max((op["time"] for op in h),
                             default=0) or 1) / SECOND
         rate = sum(len(r) for r in pts.values()) / max(duration, 1e-9)
+        bands = nemesis_bands(h)
         result = {"valid?": True, "latencies": stats,
                   "throughput-ops-per-s": rate,
                   "duration-s": duration,
-                  "nemesis-bands": nemesis_bands(h)}
+                  "nemesis-bands": bands}
         store_dir = (opts or {}).get("store_dir")
         if store_dir:
             try:
-                self._plot(pts, nemesis_bands(h), store_dir)
+                self._plot(pts, bands, store_dir)
                 result["plots"] = ["latency-raw.png", "rate.png"]
             except Exception as e:  # plotting must never fail a test run
                 result["plot-error"] = repr(e)

@@ -124,9 +124,11 @@ class TimelineHtml(Checker):
                                  None, None, None))
             return rows
         rows = []
+        # graftlint: ignore[COL002] dict fallback for loaded/legacy histories
         for op in h.client_ops():
             if not op.is_invoke:
                 continue
+            # graftlint: ignore[COL002] dict fallback for loaded/legacy histories
             comp = h.completion(op)
             if comp is not None:
                 rows.append((op["process"], op.f, comp.get("value"),

@@ -1,0 +1,114 @@
+"""Path-scoped lint policy: which invariant applies where.
+
+The rules are generic AST analyses; this module pins them to THIS
+repo's architecture — which modules are declared columnar (the static
+twin of the ``History.dict_materializations == 0`` runtime guard),
+which modules legitimately read the wall clock (the WallLoop/telemetry
+allowlist), where the reachability roots of the deterministic core
+are, and which files are out of scope entirely (generated protobufs,
+the linter itself).
+
+Tests construct a permissive ``Policy(all_in_scope=True)`` so fixture
+snippets exercise every rule without path gymnastics.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Iterable, Optional
+
+#: files never scanned: generated code and the linter's own tree
+EXCLUDE = (
+    "client/proto/*",
+    "lint/*",
+)
+
+#: modules declared columnar: dict-op APIs (History.ops, to_ops, op_at,
+#: the filter/pairing helpers) are violations here, not style — these
+#: are exactly the paths the dict_materializations==0 tier-1 guard
+#: protects dynamically (ROADMAP direction 1)
+COLUMNAR = (
+    "ops/*",
+    "checkers/set_full.py",
+    "checkers/perf.py",
+    "checkers/timeline.py",
+    "checkers/tpu_linearizable.py",
+)
+
+#: modules allowed to read the wall clock: the wall-time bridge itself,
+#: host-cost telemetry (spans measure host seconds by design), the
+#: run-phase timers feeding those counters, real-process management
+#: (readiness backoff against live etcd), and operator tooling that
+#: never touches a verdict
+DET_WALLCLOCK_ALLOW = (
+    "runner/wall.py",
+    "runner/telemetry.py",
+    "runner/trace.py",
+    "runner/test_runner.py",
+    "runner/store.py",
+    "db/local.py",
+    "db/fake_etcd.py",
+    "sut/*",            # gateway bridges: readiness deadlines against
+                        # live sockets/processes, never verdict input
+    "client/etcdctl.py",
+    "serve.py",
+    "cli.py",
+    "forensics.py",
+)
+
+#: reachability roots for DET scoping: the deterministic kernel's run
+#: loop, the generator interpreter, and every checker verdict entry.
+#: Matched against callgraph qualnames (module:Class.func) by suffix.
+ENTRY_SUFFIXES = (
+    "SimLoop.run",
+    ":interpret",
+    ".check",
+    ".check_batch",
+)
+
+#: relpath of the module whose REGISTRY assignment is the canonical
+#: telemetry name source (TEL002 reads it via ast.literal_eval — the
+#: linter never imports the package)
+TEL_REGISTRY_MODULE = "runner/telemetry.py"
+
+
+def _match(rel: str, patterns: Iterable[str]) -> bool:
+    return any(fnmatch.fnmatch(rel, p) for p in patterns)
+
+
+class Policy:
+    """Scope decisions for one lint run.
+
+    ``all_in_scope=True`` (fixture tests) makes every file columnar,
+    THR-scoped, and entry-reachable, with an empty wall-clock
+    allowlist — every rule can fire on a bare snippet.
+    """
+
+    def __init__(self, all_in_scope: bool = False,
+                 tel_registry: Optional[dict] = None):
+        self.all_in_scope = all_in_scope
+        #: {"span": [...], "counter": [...], "event": [...]} with
+        #: ``*`` wildcards; None means "not loaded" (TEL002 skipped)
+        self.tel_registry = tel_registry
+
+    def excluded(self, rel: str) -> bool:
+        if self.all_in_scope:
+            return False
+        return _match(rel, EXCLUDE)
+
+    def columnar(self, rel: str) -> bool:
+        return self.all_in_scope or _match(rel, COLUMNAR)
+
+    def wallclock_allowed(self, rel: str) -> bool:
+        if self.all_in_scope:
+            return False
+        return _match(rel, DET_WALLCLOCK_ALLOW)
+
+    def entry_point(self, qualname: str) -> bool:
+        """Is this def a reachability root? qualname: module:Class.func."""
+        if self.all_in_scope:
+            return True
+        return any(qualname.endswith(s) for s in ENTRY_SUFFIXES)
+
+    def registry_module(self, rel: str) -> bool:
+        return (not self.all_in_scope) and rel == TEL_REGISTRY_MODULE

@@ -1,0 +1,206 @@
+"""JAX: dispatch hygiene.
+
+PERF.md §1's cost model: ~57 ms fixed cost per un-jitted launch and a
+~100 ms tunnel round trip — one stray per-iteration dispatch inside a
+Python loop turns a single-kernel checker into a launch storm, and a
+jit built per call retraces per shape (the retrace-storm hazard the
+bucketed-pad discipline exists to prevent). These hazards only surface
+dynamically in BENCH rounds on a device; statically they are visible
+in the AST.
+
+- JAX001 — per-iteration ``jnp.*``/``lax.*`` dispatch inside a Python
+  ``for``/``while`` body in a function that is not device-traced
+  (jitted, or passed to ``lax``/pallas control flow). Inside a traced
+  function the loop unrolls at trace time and is fine.
+- JAX002 — host transfer (``np.asarray``/``np.array``/
+  ``jax.device_get``/``.block_until_ready()``) inside a loop in a
+  non-traced function: a device sync per iteration.
+- JAX003 — ``jax.jit`` created inside a loop or per call without a
+  cache: every call builds (and possibly retraces) a fresh callable;
+  jits belong at module level or behind ``lru_cache`` keyed on the
+  bucketed shape.
+- JAX004 — explicit float64 on the device path: TPUs emulate f64 at a
+  large multiple; the kernels here are int32/uint32 by design, so any
+  ``jnp`` float64 is either an accident or a silent-promotion hazard.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+FAMILY = "JAX"
+
+RULES = {
+    "JAX001": "per-iteration jnp dispatch in a non-traced Python loop",
+    "JAX002": "host-device transfer inside a Python loop",
+    "JAX003": "jit built per call/iteration (retrace hazard)",
+    "JAX004": "explicit float64 on the device path",
+}
+
+_JNP_ROOTS = {"jax.numpy", "jax.lax", "jax"}
+_TRANSFER_ORIGINS = {"numpy.asarray", "numpy.array", "jax.device_get"}
+_TRACED_CONSUMERS = {"while_loop", "scan", "fori_loop", "cond", "switch",
+                     "pallas_call", "jit", "vmap", "pmap", "shard_map"}
+_CACHE_DECOS = {"lru_cache", "cache"}
+
+
+def _jit_decorated(fn: ast.AST) -> bool:
+    for deco in getattr(fn, "decorator_list", ()):
+        for n in ast.walk(deco):
+            if (isinstance(n, ast.Name) and "jit" in n.id) or \
+                    (isinstance(n, ast.Attribute) and "jit" in n.attr):
+                return True
+    return False
+
+
+def _cache_decorated(fn: ast.AST) -> bool:
+    for deco in getattr(fn, "decorator_list", ()):
+        for n in ast.walk(deco):
+            if (isinstance(n, ast.Name) and n.id in _CACHE_DECOS) or \
+                    (isinstance(n, ast.Attribute) and
+                     n.attr in _CACHE_DECOS):
+                return True
+    return False
+
+
+def _traced_names(module) -> set:
+    """Names of functions that run at trace time: jit-decorated, handed
+    to jit / lax control flow / pallas, or (transitively) called from
+    one of those — a kernel helper called from a pallas body traces
+    with it."""
+    out: set = set()
+    by_name: dict = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+            if _jit_decorated(node):
+                out.add(node.name)
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        leaf = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if leaf in _TRACED_CONSUMERS:
+            # any name in the argument subtree: covers both the direct
+            # form jit(run) and the factory form
+            # pallas_call(_make_kernel(...))
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                for n in ast.walk(arg):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+    # fixpoint: a traced def's bare-name calls trace with it, and so
+    # does an inner def it returns (kernel-factory pattern)
+    frontier = list(out)
+    while frontier:
+        name = frontier.pop()
+        for d in by_name.get(name, ()):
+            fresh = set()
+            for n in ast.walk(d):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Name):
+                    fresh.add(n.func.id)
+                elif isinstance(n, ast.Return) \
+                        and isinstance(n.value, ast.Name):
+                    fresh.add(n.value.id)
+            for f_name in fresh - out:
+                out.add(f_name)
+                frontier.append(f_name)
+    return out
+
+
+def _is_traced(module, node: ast.AST, traced: set) -> bool:
+    """Any enclosing def jitted, cache-built, or passed to a traced
+    consumer ⇒ this code runs at trace time, not per host iteration."""
+    for fn in module.enclosing_functions(node):
+        if _jit_decorated(fn) or fn.name in traced:
+            return True
+    return False
+
+
+def _origin_head(module, node: ast.AST):
+    origin = module.origin(node)
+    if origin is None:
+        return None, None
+    head, _, leaf = origin.rpartition(".")
+    return origin, head
+
+
+def check(module, ctx) -> Iterator:
+    traced = _traced_names(module)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        origin = module.origin(node.func)
+        in_loop = bool(module.enclosing_loops(node))
+        traced_here = _is_traced(module, node, traced)
+        leaf = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else (node.func.id if isinstance(node.func, ast.Name)
+                  else None)
+
+        # JAX001: jnp dispatch per host-loop iteration
+        if origin is not None and in_loop and not traced_here:
+            head = origin.rpartition(".")[0]
+            if head in _JNP_ROOTS or head.startswith("jax.numpy") \
+                    or head.startswith("jax.lax"):
+                yield module.finding(
+                    "JAX001", node,
+                    f"{origin}() dispatches per loop iteration outside "
+                    "jit (~57 ms/launch fixed cost, PERF.md §1); batch "
+                    "the loop or move it under a traced control-flow "
+                    "primitive")
+
+        # JAX002: host transfer per loop iteration
+        if in_loop and not traced_here:
+            if origin in _TRANSFER_ORIGINS or \
+                    (leaf == "block_until_ready" and not node.args):
+                yield module.finding(
+                    "JAX002", node,
+                    "host-device transfer inside a Python loop forces "
+                    "a sync per iteration; hoist the transfer or batch "
+                    "the loop")
+
+        # JAX003: jit built per call / per iteration
+        if leaf == "jit" or (origin is not None
+                             and origin.endswith(".jit")):
+            encl = module.enclosing_functions(node)
+            cached = any(_cache_decorated(f) for f in encl)
+            if in_loop or (encl and not cached and not traced_here):
+                yield module.finding(
+                    "JAX003", node,
+                    "jit built per call retraces per shape; build it "
+                    "at module level or behind lru_cache keyed on the "
+                    "bucketed shape")
+
+        # JAX004: explicit float64 on the device path
+        if origin is not None and (
+                origin.rpartition(".")[0] in _JNP_ROOTS
+                or origin.startswith("jax.numpy")):
+            for kw in node.keywords:
+                if kw.arg == "dtype" and _mentions_f64(module, kw.value):
+                    yield module.finding(
+                        "JAX004", node,
+                        "explicit float64 on the device path; the "
+                        "kernels are int32/uint32 by design and TPUs "
+                        "emulate f64")
+        if leaf == "astype" and node.args \
+                and _mentions_f64(module, node.args[0]):
+            yield module.finding(
+                "JAX004", node,
+                "astype(float64): silent f64 promotion hazard on the "
+                "device path")
+
+
+def _mentions_f64(module, node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and n.value == "float64":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "float64":
+            origin = module.origin(n)
+            # numpy.float64 on a *host* array is fine; only the jnp
+            # alias (or an unresolvable root) is the device hazard
+            if origin is None or not origin.startswith("numpy."):
+                return True
+        if isinstance(n, ast.Name) and n.id == "float":
+            return True
+    return False

@@ -141,7 +141,10 @@ if HAVE_JAX:
         return run, sh
 
     def _closure_device_sharded(pad: np.ndarray, iters: int):
-        devs_key = tuple(id(d) for d in jax.devices())
+        # str(device) is a stable platform identity ("TPU_0(...)");
+        # id() is allocation order and can alias a fresh device list
+        # after GC, silently reusing a jit built for dead devices
+        devs_key = tuple(str(d) for d in jax.devices())
         run, sh = _closure_sharded_jitted(iters, devs_key)
         # single host->sharded transfer (device_put straight from numpy;
         # jnp.asarray first would commit to one device then reshard)

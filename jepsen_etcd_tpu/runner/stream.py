@@ -60,6 +60,9 @@ class StreamFeed:
         self.rows = 0                 # rows consumed by the worker
         self.backlog_peak = 0
         self.error: Optional[BaseException] = None
+        # set under _cv when finish() gives up on a wedged worker; the
+        # worker must not install finalize results past this point
+        self._abandoned = False
         # per-workload consumers, created lazily on the worker thread
         wl = test.get("workload") if isinstance(test, dict) else None
         self._want_pack = wl == "register"
@@ -114,16 +117,18 @@ class StreamFeed:
             except BaseException as e:  # withdraw hints, never crash a run
                 logger.warning("stream consumer failed; hints withdrawn",
                                exc_info=True)
-                self.error = e
-                self._pack = self._scan = None
-                self._want_pack = self._want_scan = False
+                with self._cv:
+                    self.error = e
+                    self._pack = self._scan = None
+                    self._want_pack = self._want_scan = False
         try:
             self._finalize_consumers()
         except BaseException as e:
             logger.warning("stream finalize failed; hints withdrawn",
                            exc_info=True)
-            self.error = e
-            self._pack_result = self._scan_result = None
+            with self._cv:
+                self.error = e
+                self._pack_result = self._scan_result = None
 
     def _consume(self, cols: Any) -> None:
         tel = telemetry.current()
@@ -131,30 +136,41 @@ class StreamFeed:
             if self._want_pack:
                 if self._pack is None:
                     from ..ops.wgl import PackStream
-                    self._pack = PackStream()
+                    with self._cv:
+                        self._pack = PackStream()
                 self._pack.feed(cols)
             if self._want_scan:
                 if self._scan is None:
                     from ..checkers.set_full import ColumnScan
-                    self._scan = ColumnScan()
+                    with self._cv:
+                        self._scan = ColumnScan()
                 try:
                     self._scan.feed(cols)
                 except Exception:  # _NonColumnar rows: scan withdrawn
-                    self._scan = None
-                    self._want_scan = False
-        self.chunks += 1
-        self.rows += len(cols)
+                    with self._cv:
+                        self._scan = None
+                        self._want_scan = False
+        with self._cv:
+            self.chunks += 1
+            self.rows += len(cols)
         tel.counter("stream.chunks")
         tel.counter("stream.flushed_events", len(cols))
 
     def _finalize_consumers(self) -> None:
         tel = telemetry.current()
+        pack_result = scan_result = None
         if self._pack is not None:
             with tel.span("stream.finalize", kind="register-pack"):
-                self._pack_result = self._pack.finish()  # None if bad
+                pack_result = self._pack.finish()  # None if bad
         if self._scan is not None:
             with tel.span("stream.finalize", kind="set-scan"):
-                self._scan_result = self._scan.finish()
+                scan_result = self._scan.finish()
+        # a worker that wedged past finish()'s join bound must not
+        # install results the run already declared withdrawn
+        with self._cv:
+            if not self._abandoned:
+                self._pack_result = pack_result
+                self._scan_result = scan_result
 
     # -- epilogue (runner, after generation) ---------------------------------
 
@@ -170,20 +186,29 @@ class StreamFeed:
             if self._thread.is_alive():
                 logger.warning("stream worker did not drain in %.0fs; "
                                "hints withdrawn", JOIN_TIMEOUT_S)
-                self._pack_result = self._scan_result = None
+                with self._cv:
+                    self._abandoned = True
+                    self._pack_result = self._scan_result = None
         tel = telemetry.current()
+        # snapshot under the lock: a worker alive past the join bound
+        # must not mutate what this epilogue publishes
+        with self._cv:
+            error = self.error
+            chunks, rows = self.chunks, self.rows
+            pack_result = self._pack_result
+            scan_result = self._scan_result
         tel.counter("stream.backlog_peak", self.backlog_peak, mode="max")
-        hints: dict = {"stats": {"chunks": self.chunks,
-                                 "rows": self.rows,
+        hints: dict = {"stats": {"chunks": chunks,
+                                 "rows": rows,
                                  "backlog_peak": self.backlog_peak,
                                  "chunk_ops": self.chunk_ops}}
         # hints are only safe when the worker consumed the WHOLE
         # recorded stream — a partial feed (error, wedged worker) must
         # not masquerade as the full history's artifacts
-        if self.error is None and self.rows == len(history):
-            if self._pack_result is not None:
-                hints["register_packs"] = (self._pack_result, self.rows)
-            if self._scan_result is not None:
-                hints["set_scan"] = (self._scan_result, self.rows)
+        if error is None and rows == len(history):
+            if pack_result is not None:
+                hints["register_packs"] = (pack_result, rows)
+            if scan_result is not None:
+                hints["set_scan"] = (scan_result, rows)
         self.test["_stream"] = hints
         return hints

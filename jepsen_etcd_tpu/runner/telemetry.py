@@ -55,6 +55,56 @@ EVENT_FIELDS = ("kind", "name", "t", "attrs")
 #: never buffered (a pathological dispatch loop must not eat the disk)
 MAX_RECORDS = 200_000
 
+#: the canonical name inventory. The cross-run dashboard
+#: (forensics/telemetry readers) joins series by name, so a typo'd
+#: name silently starts a fresh series; graftlint TEL002 checks every
+#: ``span``/``counter``/``event`` call site against this literal (read
+#: via ast.literal_eval — never imported). ``*`` matches a
+#: parameterized segment (``phase:<name>``, ``stream.<field>_reuse``).
+#: Adding an emit site means adding its name here, in the same commit.
+REGISTRY = {
+    "spans": (
+        "phase:*",            # setup/generate/teardown/check/save/...
+        "checker:*",          # one composed checker's pass
+        "cell:*",             # bench.py per-cell spans
+        "wgl.spill",
+        "wgl.batch-dispatch",
+        "wgl.check_packed",
+        "wgl.pack",
+        "wgl.pack-batch",
+        "mxu.dispatch",
+        "mxu.launch",
+        "mxu.collect",
+        "closure.device",
+        "closure.host",
+        "stream.chunk",
+        "stream.finalize",
+    ),
+    "counters": (
+        "generate.ops_per_s",
+        "columns.events",
+        "columns.keyed",
+        "columns.extras",
+        "columns.disabled",
+        "stream.chunks",
+        "stream.flushed_events",
+        "stream.backlog_peak",
+        "stream.resume_rungs",
+        "stream.pack_reuse",
+        "stream.*_reuse",     # per-consumer reuse, runner/stream.py
+        "engine.*",           # verdict-engine routing tally,
+                              # checkers/tpu_linearizable.py
+        "wgl.dispatches",
+        "wgl.rungs",
+        "wgl.max-frontier",
+        "wgl.host-spill",
+        "mxu.dispatches",
+    ),
+    "events": (
+        "telemetry.dropped",
+    ),
+}
+
 
 class _Span:
     """Context manager for one span; ``set(**attrs)`` attaches result

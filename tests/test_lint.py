@@ -1,0 +1,397 @@
+"""graftlint: per-family fixture tests, suppression/baseline
+machinery, and the tier-1 gate asserting the tree itself is clean.
+
+Fixture snippets lint under ``Policy(all_in_scope=True)`` — every file
+columnar, every def entry-reachable, no wall-clock allowlist — so each
+rule can fire on a bare tmp file without path gymnastics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from jepsen_etcd_tpu.lint import Policy, run_lint
+from jepsen_etcd_tpu.lint.engine import write_baseline
+from jepsen_etcd_tpu.lint.rules import ALL_RULES, select
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TEL_REGISTRY = {"spans": ("phase:*", "good.span"),
+                "counters": ("a.b", "stream.*_reuse"),
+                "events": ("boom",)}
+
+
+def lint_snippet(tmp_path, source, name="snippet.py", rules=None,
+                 baseline_path=None):
+    f = tmp_path / name
+    f.write_text(source)
+    return run_lint(paths=[str(f)], rules=rules,
+                    baseline_path=baseline_path,
+                    policy=Policy(all_in_scope=True,
+                                  tel_registry=TEL_REGISTRY),
+                    root=str(tmp_path))
+
+
+def rules_fired(report):
+    return {f.rule for f in report.findings if not f.suppressed}
+
+
+# -- DET ---------------------------------------------------------------------
+
+def test_det001_wall_clock_fires(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"))
+    assert "DET001" in rules_fired(r)
+
+
+def test_det001_virtual_clock_clean(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "def stamp(loop):\n"
+        "    return loop.now()\n"))
+    assert "DET001" not in rules_fired(r)
+
+
+def test_det002_unseeded_random_fires(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "import random\n"
+        "def draw():\n"
+        "    return random.random()\n"))
+    assert "DET002" in rules_fired(r)
+
+
+def test_det002_seeded_instance_clean(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "import random\n"
+        "def draw(seed):\n"
+        "    return random.Random(seed).random()\n"))
+    assert "DET002" not in rules_fired(r)
+
+
+def test_det003_set_iteration_and_id(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "def order(xs, y):\n"
+        "    out = list(set(xs))\n"
+        "    for v in set(xs) | {1}:\n"
+        "        out.append(v)\n"
+        "    return out, id(y)\n"))
+    assert sum(f.rule == "DET003" for f in r.findings) == 3
+
+
+def test_det003_sorted_set_clean(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "def order(xs):\n"
+        "    return sorted(set(xs))\n"))
+    assert "DET003" not in rules_fired(r)
+
+
+# -- COL ---------------------------------------------------------------------
+
+def test_col001_ops_materialization_fires(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "def rows(h):\n"
+        "    return [op for op in h.ops] + h.to_ops()\n"))
+    assert sum(f.rule == "COL001" for f in r.findings) == 2
+
+
+def test_col002_dict_api_fires(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "def bands(h):\n"
+        "    return [h.completion(op) for op in h.nemesis_ops()]\n"))
+    assert sum(f.rule == "COL002" for f in r.findings) == 2
+
+
+def test_col_columnar_accessors_clean(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "def rows(cols):\n"
+        "    return cols.client_pairs(), cols.time.tolist()\n"))
+    assert not {"COL001", "COL002"} & rules_fired(r)
+
+
+def test_col_scoped_to_columnar_modules(tmp_path):
+    # default policy: only policy.COLUMNAR paths are in scope
+    f = tmp_path / "plain.py"
+    f.write_text("def rows(h):\n    return h.ops\n")
+    r = run_lint(paths=[str(f)], baseline_path=None,
+                 policy=Policy(), root=str(tmp_path))
+    assert "COL001" not in rules_fired(r)
+
+
+# -- JAX ---------------------------------------------------------------------
+
+def test_jax001_loop_dispatch_fires(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "def walk(x):\n"
+        "    for _ in range(8):\n"
+        "        x = jnp.add(x, 1)\n"
+        "    return x\n"))
+    assert "JAX001" in rules_fired(r)
+
+
+def test_jax001_jitted_loop_clean(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def walk(x):\n"
+        "    for _ in range(8):\n"
+        "        x = jnp.add(x, 1)\n"
+        "    return x\n"))
+    assert "JAX001" not in rules_fired(r)
+
+
+def test_jax001_factory_kernel_clean(tmp_path):
+    # pallas_call(_make_kernel(...)) traces the returned inner def
+    r = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "from jax.experimental.pallas import pallas_call\n"
+        "def _make_kernel(n):\n"
+        "    def kernel(ref):\n"
+        "        for i in range(n):\n"
+        "            ref[i] = jnp.add(ref[i], 1)\n"
+        "    return kernel\n"
+        "call = pallas_call(_make_kernel(4))\n"))
+    assert "JAX001" not in rules_fired(r)
+
+
+def test_jax002_transfer_in_loop_fires(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "import numpy as np\n"
+        "def collect(devs):\n"
+        "    out = []\n"
+        "    for d in devs:\n"
+        "        out.append(np.asarray(d))\n"
+        "    return out\n"))
+    assert "JAX002" in rules_fired(r)
+
+
+def test_jax003_jit_per_call_fires(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "import jax\n"
+        "def run(x):\n"
+        "    return jax.jit(lambda v: v + 1)(x)\n"))
+    assert "JAX003" in rules_fired(r)
+
+
+def test_jax003_cached_jit_clean(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "import functools\n"
+        "import jax\n"
+        "@functools.lru_cache(maxsize=None)\n"
+        "def kernel(n):\n"
+        "    return jax.jit(lambda v: v + n)\n"))
+    assert "JAX003" not in rules_fired(r)
+
+
+def test_jax004_float64_fires(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "def zeros(n):\n"
+        "    return jnp.zeros(n, dtype='float64')\n"))
+    assert "JAX004" in rules_fired(r)
+
+
+# -- THR ---------------------------------------------------------------------
+
+_THR_RACY = """\
+import threading
+
+class Feed:
+    def __init__(self):
+        self.rows = 0
+        self._cv = threading.Condition()
+        self._t = threading.Thread(target=self._worker)
+
+    def _worker(self):
+        self.rows += 1
+"""
+
+
+def test_thr001_unlocked_write_fires(tmp_path):
+    r = lint_snippet(tmp_path, _THR_RACY)
+    assert "THR001" in rules_fired(r)
+
+
+def test_thr001_locked_write_clean(tmp_path):
+    r = lint_snippet(tmp_path, _THR_RACY.replace(
+        "        self.rows += 1",
+        "        with self._cv:\n            self.rows += 1"))
+    assert "THR001" not in rules_fired(r)
+
+
+def test_thr002_global_rebind_fires(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "import threading\n"
+        "N = 0\n"
+        "def _worker():\n"
+        "    global N\n"
+        "    N += 1\n"
+        "t = threading.Thread(target=_worker)\n"))
+    assert "THR002" in rules_fired(r)
+
+
+# -- TEL ---------------------------------------------------------------------
+
+def test_tel001_unentered_span_fires(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "def trace(tel):\n"
+        "    tel.span('good.span')\n"))
+    assert "TEL001" in rules_fired(r)
+
+
+def test_tel001_with_span_clean(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "def trace(tel):\n"
+        "    with tel.span('good.span'):\n"
+        "        pass\n"))
+    assert "TEL001" not in rules_fired(r)
+
+
+def test_tel002_unregistered_name_fires(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "def bump(tel):\n"
+        "    tel.counter('a.typo')\n"))
+    assert "TEL002" in rules_fired(r)
+
+
+def test_tel002_wildcard_and_prefix_clean(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "def bump(tel, name):\n"
+        "    tel.counter('a.b')\n"
+        "    tel.counter(f'stream.{name}_reuse')\n"
+        "    with tel.span('phase:setup'):\n"
+        "        pass\n"))
+    assert "TEL002" not in rules_fired(r)
+
+
+def test_tel_re_match_span_not_confused(tmp_path):
+    # re.Match.span(1) has no string arg: not the telemetry signature
+    r = lint_snippet(tmp_path, (
+        "import re\n"
+        "def where(m):\n"
+        "    return m.span(1)\n"))
+    assert not {"TEL001", "TEL002"} & rules_fired(r)
+
+
+# -- suppressions ------------------------------------------------------------
+
+def test_suppression_with_reason_silences(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "import random\n"
+        "def draw():\n"
+        "    # graftlint: ignore[DET002] fixture exercises the grammar\n"
+        "    return random.random()\n"))
+    assert not r.errors
+    assert any(f.rule == "DET002" and f.suppressed for f in r.findings)
+
+
+def test_suppression_inline_and_family(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "import random\n"
+        "def draw():\n"
+        "    return random.random()  "
+        "# graftlint: ignore[DET] family-wide fixture\n"))
+    assert not r.errors
+
+
+def test_suppression_without_reason_is_lint002(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "import random\n"
+        "def draw():\n"
+        "    return random.random()  # graftlint: ignore[DET002]\n"))
+    assert {f.rule for f in r.errors} == {"LINT002"}
+
+
+def test_orphan_suppression_is_lint001(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "def clean():\n"
+        "    # graftlint: ignore[DET002] nothing fires here\n"
+        "    return 1\n"))
+    assert {f.rule for f in r.errors} == {"LINT001"}
+
+
+# -- baseline ----------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    src = ("import random\n"
+           "def draw():\n"
+           "    return random.random()\n")
+    bl = tmp_path / "baseline.json"
+    first = lint_snippet(tmp_path, src)
+    assert first.errors
+    write_baseline(str(bl), first.findings)
+    # grandfathered: same findings, zero errors
+    again = lint_snippet(tmp_path, src, baseline_path=str(bl))
+    assert not again.errors
+    assert any(f.baselined for f in again.findings)
+    # finding fixed: the stale entry must flag LINT004
+    fixed = lint_snippet(tmp_path, (
+        "import random\n"
+        "def draw(seed):\n"
+        "    return random.Random(seed).random()\n"),
+        baseline_path=str(bl))
+    assert {f.rule for f in fixed.errors} == {"LINT004"}
+
+
+# -- selection ---------------------------------------------------------------
+
+def test_select_by_family_and_id():
+    fams = {f.FAMILY for f in select(["DET"])}
+    assert fams == {"DET"}
+    fams = {f.FAMILY for f in select(["col001", "TEL"])}
+    assert fams == {"COL", "TEL"}
+    with pytest.raises(ValueError):
+        select(["NOPE999"])
+    assert len(ALL_RULES) == 13
+
+
+def test_rule_filter_scopes_findings(tmp_path):
+    # selection is family-granular: asking for DET002 runs the DET
+    # family and nothing else
+    r = lint_snippet(tmp_path, (
+        "import random\n"
+        "def draw(tel):\n"
+        "    tel.counter('a.typo')\n"
+        "    return random.random()\n"),
+        rules=["DET002"])
+    fired = rules_fired(r)
+    assert "DET002" in fired
+    assert all(rule.startswith("DET") for rule in fired)
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+def test_tree_is_lint_clean():
+    """THE gate: the shipped tree has zero non-suppressed,
+    non-baselined findings. A regression anywhere in the five families
+    (or an orphaned suppression, or a stale baseline entry) fails
+    tier-1 here."""
+    report = run_lint(root=REPO)
+    msgs = [f"{f.location()}: {f.rule}: {f.message}"
+            for f in report.errors]
+    assert not msgs, "\n".join(msgs)
+    assert report.files > 50  # the whole package was actually scanned
+
+
+def test_cli_exit_codes(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, "-m", "jepsen_etcd_tpu.lint", "--json"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    data = json.loads(out.stdout)
+    assert data["errors"] == 0
+    bad = tmp_path / "bad.py"
+    bad.write_text("import random\nx = random.random()\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "jepsen_etcd_tpu.lint", str(bad)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1
+    assert "DET002" in out.stdout
