@@ -811,6 +811,82 @@ def bench_streaming_overlap():
                                  2)}
 
 
+def bench_campaign_amortization():
+    """Campaign cell (PERF.md §campaign): the SAME 12-run seed matrix
+    three ways — serial in-process test-all (the baseline every prior
+    round paid), pooled campaign without the service (spawn
+    parallelism only; every worker still owns a jax runtime and pays
+    its own dispatches + compiles), and pooled campaign + shared
+    checker service (ONE device owner; workers ship packed histories
+    over the socket and never import jax). ``force_kernel`` pins every
+    history to the device path so the dispatch ledger is visible on
+    CPU CI too.
+
+    The durable number is the dispatch ledger, not wall clock: per-run
+    checking pays >= 1 dispatch per run per (bucket, width); the
+    service pays 1 per (bucket, width, tick) however many runs' keys
+    share it. On this box the device is jax-cpu and wall clocks are
+    compile-dominated, so wall is REPORTED, never asserted."""
+    from jepsen_etcd_tpu.runner.campaign import (campaign_specs,
+                                                 run_campaign)
+    base = {"time_limit": 3, "rate": 100.0, "force_kernel": True,
+            "nodes": ["n1", "n2", "n3"], "snapshot_count": 100_000}
+
+    def specs():
+        return campaign_specs(base, ["register"], [[], ["kill"]],
+                              runs_per_cell=6, seed0=31)
+
+    def run_dispatches(summary):
+        return sum(r.get("dispatches", 0) for r in summary["runs"]
+                   if r and r.get("status") == "done")
+
+    serial = run_campaign(specs(), pool=0, service=False,
+                          name="bench-campaign-serial")
+    pooled = run_campaign(specs(), pool=4, service=False,
+                          name="bench-campaign-pooled")
+    svc = run_campaign(specs(), pool=4, service=True,
+                       name="bench-campaign-service")
+    for arm in (serial, pooled, svc):
+        assert arm["valid?"], arm["failures"]
+    # same seeds => same verdicts, whichever arm checked them
+    valids = [[r["valid"] for r in arm["runs"]]
+              for arm in (serial, pooled, svc)]
+    assert valids[0] == valids[1] == valids[2], valids
+    sctr = (svc["service"] or {}).get("counters") or {}
+    svc_dispatches = int(sctr.get("wgl.dispatches", 0)
+                         + sctr.get("mxu.dispatches", 0))
+    amort = run_dispatches(serial) / max(svc_dispatches
+                                         + run_dispatches(svc), 1)
+    note(f"campaign-amortization: {serial['count']} runs; dispatches "
+         f"serial={run_dispatches(serial)} pooled={run_dispatches(pooled)} "
+         f"service={svc_dispatches} (+{run_dispatches(svc)} local, "
+         f"ticks={sctr.get('service.ticks')}, "
+         f"group_ticks={sctr.get('service.group_ticks')}, "
+         f"occupancy<={sctr.get('service.batch_occupancy')}); wall "
+         f"serial={serial['wall_s']}s pooled={pooled['wall_s']}s "
+         f"service={svc['wall_s']}s")
+    return {"value": round(amort, 2), "unit": "dispatch-amortization",
+            "runs": serial["count"],
+            "serial": {"wall_s": serial["wall_s"],
+                       "dispatches": run_dispatches(serial)},
+            "pooled": {"wall_s": pooled["wall_s"],
+                       "dispatches": run_dispatches(pooled)},
+            "service": {"wall_s": svc["wall_s"],
+                        "dispatches": svc_dispatches,
+                        "local_dispatches": run_dispatches(svc),
+                        "submitted": sctr.get("service.submitted"),
+                        "coalesced": sctr.get("service.coalesced"),
+                        "ticks": sctr.get("service.ticks"),
+                        "group_ticks": sctr.get("service.group_ticks"),
+                        "batch_occupancy":
+                            sctr.get("service.batch_occupancy"),
+                        "fallbacks": sum(
+                            r.get("service_fallbacks", 0)
+                            for r in svc["runs"] if r)},
+            "vs_baseline": round(serial["wall_s"]
+                                 / max(svc["wall_s"], 1e-9), 2)}
+
+
 CELLS = [("register_100", bench_register_100),
          ("engine_crossover", bench_engine_crossover),
          ("deep_wgl_4n_2000", bench_deep_wgl),
@@ -824,7 +900,8 @@ CELLS = [("register_100", bench_register_100),
          ("elle_append_device", bench_elle_append),
          ("closure_scale_2048", bench_closure_scale),
          ("watch_edit_distance", bench_watch),
-         ("streaming_overlap", bench_streaming_overlap)]
+         ("streaming_overlap", bench_streaming_overlap),
+         ("campaign_amortization", bench_campaign_amortization)]
 
 
 # ---------------------------------------------------------------------
@@ -1001,6 +1078,59 @@ def _dry_streaming():
     return {"ops": len(s_out["history"]), "chunks": stats["chunks"]}
 
 
+def _dry_campaign():
+    """Campaign structure at tiny size: the Packed wire format
+    round-trips bit-identically, a live checker service returns the
+    SAME verdict projection as local ``check_packed`` for the same
+    packs (singleton ladder AND cross-history batch), its coalescing
+    counters account for every submitted pack, and a dead socket
+    degrades to local checking (client_for -> None), never an error."""
+    import numpy as np
+    from jepsen_etcd_tpu.ops import wgl
+    from jepsen_etcd_tpu.runner import checker_service as svc_mod
+
+    subs, _, _ = _sim_keys(range(2), 30, 4, _DRY_SEED, "dry-campaign",
+                           nodes=["n1", "n2", "n3"])
+    packs = [wgl.pack_register_history(subs[k]) for k in range(2)]
+    for p in packs:
+        assert p.ok, p.reason
+        q = wgl.deserialize_packed(wgl.serialize_packed(p))
+        _assert_packs_equal(p, q)
+
+    proj = ("valid?", "waves", "peak-frontier", "ops", "info-ops",
+            "op", "error", "stuck-at-depth")
+
+    def view(out):
+        return {k: out.get(k) for k in proj}
+
+    local = [wgl.check_packed(p) for p in packs]
+    svc = svc_mod.CheckerService(tick_s=0.01).start()
+    try:
+        client = svc_mod.CheckerClient(svc.path)
+        # one pack per request: singleton-ladder route in the service
+        one = client.check(packs[:1])
+        assert one is not None and view(one[0]) == view(local[0]), one
+        # both packs in one request: cross-history batched route
+        both = client.check(packs)
+        assert both is not None, "service unreachable"
+        for got, want in zip(both, local):
+            assert view(got) == view(want), (view(got), view(want))
+        ctr = (svc.stats().get("counters") or {})
+        assert ctr.get("service.submitted") == 3, ctr
+        assert ctr.get("service.requests") == 2, ctr
+        assert ctr.get("service.ticks", 0) >= 1, ctr
+        client.close()
+    finally:
+        svc.close()
+    # degradation: dead socket -> no client -> caller checks locally
+    svc_mod.reset_clients()
+    dead = svc_mod.client_for({"checker_service": svc.path})
+    assert dead is None, "client_for returned a client for a dead socket"
+    svc_mod.reset_clients()
+    return {"packs": len(packs), "ops": int(sum(p.R for p in packs)),
+            "verdicts_identical": True}
+
+
 DRY_CHECKS = {"register_100": _dry_register,
               "engine_crossover": _dry_register,
               "deep_wgl_4n_2000": _dry_register,
@@ -1015,6 +1145,7 @@ DRY_CHECKS = {"register_100": _dry_register,
               "closure_scale_2048": _dry_closure,
               "watch_edit_distance": _dry_watch,
               "streaming_overlap": _dry_streaming,
+              "campaign_amortization": _dry_campaign,
               "register_10k": _dry_register}
 
 
@@ -1023,7 +1154,11 @@ DRY_CHECKS = {"register_100": _dry_register,
 #: time, and a determinism/columnar/dispatch regression there makes
 #: the numbers wrong before they're slow
 LINT_GATED = ("jepsen_etcd_tpu/ops/wgl.py",
-              "jepsen_etcd_tpu/checkers/set_full.py")
+              "jepsen_etcd_tpu/checkers/set_full.py",
+              # the campaign cell times these two: a thread-safety or
+              # determinism slip there corrupts the dispatch ledger
+              "jepsen_etcd_tpu/runner/campaign.py",
+              "jepsen_etcd_tpu/runner/checker_service.py")
 
 
 def _lint_gate() -> None:

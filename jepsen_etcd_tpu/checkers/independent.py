@@ -11,6 +11,7 @@ padded tensor and vmap over it (SURVEY §2.3).
 from __future__ import annotations
 
 from ..core.history import History
+from ..runner import telemetry
 from .core import Checker, _merge_valid, stream_hint
 
 
@@ -38,6 +39,12 @@ class Independent(Checker):
         # per-op dict access on this path (guarded by the
         # dict_materializations test in tests/test_history.py).
         subs = h.split_by_key()
+        # the run's key fanout: how many per-key checks this split
+        # produced — the producer side of the batching axis (within a
+        # run here; across runs when a campaign checker service
+        # coalesces many runs' keys into shared ticks, PERF.md
+        # §campaign)
+        telemetry.current().counter("independent.keys", len(subs))
         if hasattr(self.inner, "check_batch"):
             # batch-aware inner checker (TPULinearizableChecker): one
             # vmapped kernel launch over the whole key batch, sharded

@@ -175,6 +175,32 @@ class TPULinearizableChecker(Checker):
                                      adapter=wgl.mutex_adapter)
         return None
 
+    def _service_check(self, test, packs: list) -> Optional[list]:
+        """Ship device-bound packs to the campaign checker service
+        (runner/checker_service.py) when one is configured, returning
+        verdicts aligned with packs — or None, meaning "check
+        in-process". Only device-bound work ships: the size-cutoff
+        routing ran before packing, and the CPU diagnostics / overflow
+        DFS / fallback ladder all run locally on what comes back
+        (_finalize), so verdicts are independent of WHERE the kernel
+        ran. Any service failure degrades to the exact in-process path
+        (counted as service.fallback) — a dead service costs latency,
+        never verdicts."""
+        from ..runner import checker_service as svc
+        if svc.endpoint_for(test) is None:
+            return None
+        client = svc.client_for(test)
+        outs = client.check(packs) if client is not None else None
+        if outs is None:
+            telemetry.current().counter("service.fallback")
+        else:
+            # producer-side ledger: what THIS run shipped. Summed over
+            # a campaign's runs, service.shipped must equal the
+            # service's own service.submitted (the e2e test pins it).
+            telemetry.current().counter("service.checks")
+            telemetry.current().counter("service.shipped", len(packs))
+        return outs
+
     def _finalize(self, history, out: dict, pack=None,
                   band=(None, None, 0)) -> dict:
         """Post-process one kernel verdict into a checker result,
@@ -298,9 +324,17 @@ class TPULinearizableChecker(Checker):
                 return cpu
             small_unknown, band_budget = cpu, self.FALLBACK_MAX_CONFIGS
         # with a fallback available, defer the spill BFS until the DFS
-        # has had its (cheaper) shot — see _overflow
-        out = wgl.check_packed(p, f_max=self.f_max,
-                               spill=not self.fallback)
+        # has had its (cheaper) shot — see _overflow. The service path
+        # rides the same deferral (its batch runs spill=False), so it
+        # only engages when a fallback exists to match semantics.
+        out = None
+        if self.f_max is None and self.fallback:
+            svc_outs = self._service_check(test, [p])
+            if svc_outs is not None:
+                out = svc_outs[0]
+        if out is None:
+            out = wgl.check_packed(p, f_max=self.f_max,
+                                   spill=not self.fallback)
         return self._finalize(history, out, pack=p,
                               band=(None, small_unknown, band_budget))
 
@@ -373,25 +407,35 @@ class TPULinearizableChecker(Checker):
                                      for k in big_keys})
         packs = [packed[k] for k in big_keys]
         outs: list = [None] * len(big_keys)
-        if self.f_max is None:
-            launched = wgl._run_fused(
-                wgl._mxu_broken, "mxu batch",
-                lambda: wgl_mxu.launch_packed_batch_mxu(packs))
-            if launched:
-                wgl._run_fused(
+        # campaign mode: the checker service owns the device and
+        # coalesces these packs with every other run's pending work
+        # into one dispatch per (bucket, width) per tick — the batch
+        # axis extended ACROSS runs. Absent/dead service: None, and
+        # the in-process path below runs unchanged.
+        svc_outs = self._service_check(test, packs) \
+            if self.f_max is None else None
+        if svc_outs is not None:
+            outs = svc_outs
+        else:
+            if self.f_max is None:
+                launched = wgl._run_fused(
                     wgl._mxu_broken, "mxu batch",
-                    lambda: wgl_mxu.collect_packed_batch_mxu(launched,
-                                                             outs))
-        # keys the fused path couldn't take (unsupported shapes,
-        # frontier overflow) ride the jnp ladder batch as before
-        rest = [i for i, out in enumerate(outs)
-                if out is None or out.get("overflow")]
-        if rest:
-            rest_outs = wgl.check_packed_batch(
-                [packs[i] for i in rest], f_max=self.f_max,
-                try_fused=False)
-            for i, out in zip(rest, rest_outs):
-                outs[i] = out
+                    lambda: wgl_mxu.launch_packed_batch_mxu(packs))
+                if launched:
+                    wgl._run_fused(
+                        wgl._mxu_broken, "mxu batch",
+                        lambda: wgl_mxu.collect_packed_batch_mxu(
+                            launched, outs))
+            # keys the fused path couldn't take (unsupported shapes,
+            # frontier overflow) ride the jnp ladder batch as before
+            rest = [i for i, out in enumerate(outs)
+                    if out is None or out.get("overflow")]
+            if rest:
+                rest_outs = wgl.check_packed_batch(
+                    [packs[i] for i in rest], f_max=self.f_max,
+                    try_fused=False)
+                for i, out in zip(rest, rest_outs):
+                    outs[i] = out
         # unpackable keys come back "unknown" with the pack reason;
         # _finalize routes those through the CPU fallback (and top-rung
         # overflows through the DFS-then-spill ordering), skipping any

@@ -32,8 +32,10 @@ ALL_NEMESES = [
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="jepsen_etcd_tpu")
     sub = p.add_subparsers(dest="command", required=True)
-    for cmd in ("test", "test-all"):
+    subs = {}
+    for cmd in ("test", "test-all", "campaign"):
         s = sub.add_parser(cmd)
+        subs[cmd] = s
         # None means "register" for test, "all workloads" for test-all
         # (the reference's test-all honors -w as a narrowing filter,
         # etcd.clj:238-242)
@@ -123,6 +125,48 @@ def build_parser() -> argparse.ArgumentParser:
         s.add_argument("--only-workloads-expected-to-pass",
                        action="store_true")
         s.add_argument("--store", default="store")
+        s.add_argument("--checker-service", default=None,
+                       help="AF_UNIX socket of a running checker "
+                            "service (see the checker-service "
+                            "subcommand): device-bound checks are "
+                            "shipped there and batched across every "
+                            "submitting run; unset = check in-process "
+                            "(campaign hosts its own unless "
+                            "--no-service)")
+    camp = subs["campaign"]
+    camp.add_argument("--pool", type=int, default=4,
+                      help="worker processes running tests concurrently "
+                           "(0 = inline in this process)")
+    camp.add_argument("--no-service", action="store_true",
+                      help="skip the shared checker service: every "
+                           "worker dispatches its own device checks "
+                           "(pays the per-run dispatch floor)")
+    camp.add_argument("--service-tick", type=float, default=0.05,
+                      help="checker-service coalescing window in "
+                           "seconds: pending packs from all runners "
+                           "batch into one dispatch per (bucket, "
+                           "width) per tick")
+    camp.add_argument("--campaign-name", default="campaign",
+                      help="store dir name for the campaign summary "
+                           "(store/<name>/<id>/campaign.json)")
+    camp.add_argument("--force-kernel", action="store_true",
+                      help="disable the native-DFS size cutoff so "
+                           "every key is device-bound (coalescing "
+                           "demos/tests; production keeps the "
+                           "measured routing)")
+    cs = sub.add_parser("checker-service",
+                        help="run a standalone batched TPU checker "
+                             "service: one process owns the device; "
+                             "concurrent test/campaign invocations "
+                             "point --checker-service at its socket "
+                             "and their device checks coalesce into "
+                             "one dispatch per (bucket, width) per "
+                             "tick")
+    cs.add_argument("--socket", default=None,
+                    help="AF_UNIX socket path (default: a fresh temp "
+                         "path, printed on stdout)")
+    cs.add_argument("--tick", type=float, default=0.05,
+                    help="coalescing window seconds")
     srv = sub.add_parser("serve", help="serve the store dir over HTTP "
                                        "(etcd.clj:250-252)")
     srv.add_argument("--store", default="store")
@@ -192,6 +236,7 @@ def opts_from_args(args) -> dict:
         "debug": args.debug,
         "tcpdump": args.tcpdump,
         "no_telemetry": getattr(args, "no_telemetry", False),
+        "checker_service": getattr(args, "checker_service", None),
         "stream": getattr(args, "stream", False),
         "stream_chunk_ops": getattr(args, "stream_chunk_ops", 1024),
         "soak": getattr(args, "soak", False),
@@ -265,6 +310,55 @@ def main(argv=None) -> int:
     # kernel-running commands only: initializes the jax backend
     from .ops.common import enable_compile_cache
     enable_compile_cache()
+    if args.command == "checker-service":
+        import time as _time
+        from .runner.checker_service import CheckerService
+        svc = CheckerService(path=args.socket, tick_s=args.tick).start()
+        print(json.dumps({"checker-service": svc.path}), flush=True)
+        try:
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            svc.close()
+        return 0
+    if args.command == "campaign":
+        from .runner.campaign import campaign_specs, run_campaign
+        base = opts_from_args(args)
+        if args.force_kernel:
+            base["force_kernel"] = True
+        wls, nemeses = test_all_matrix(args)
+        specs = campaign_specs(base, wls, nemeses,
+                               runs_per_cell=args.test_count,
+                               seed0=args.seed)
+
+        def _print_row(row):
+            print(json.dumps({k: row.get(k) for k in
+                              ("index", "workload", "nemesis", "seed",
+                               "status", "valid", "dir", "wall_s")}))
+
+        out = run_campaign(
+            specs, pool=args.pool,
+            # an external service (--checker-service) rides in via the
+            # per-spec opts; hosting one on top would shadow it
+            service=not args.no_service and not base.get(
+                "checker_service"),
+            service_tick_s=args.service_tick,
+            store_base=args.store, name=args.campaign_name,
+            on_row=_print_row)
+        svc_counters = ((out.get("service") or {}).get("counters")
+                        or {})
+        print(json.dumps({
+            "campaign": out["name"], "dir": out["dir"],
+            "runs": out["count"], "valid?": out["valid?"],
+            "failures": [repr(f) for f in out["failures"]],
+            "wall_s": out["wall_s"],
+            "service": {k: svc_counters[k] for k in sorted(svc_counters)
+                        if k.startswith(("service.", "wgl.", "mxu."))}
+            if svc_counters else None,
+        }))
+        return 0 if out["valid?"] else 1
     if args.command == "test":
         opts = opts_from_args(args)
         if opts.get("soak"):

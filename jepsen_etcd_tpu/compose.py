@@ -65,6 +65,18 @@ def default_opts() -> dict:
                                         # --time-limit)
         "version": "sim-3.5.6",         # etcd.clj:206-207 (pinned: the sim
                                         # has exactly one "binary")
+        "checker_service": None,        # AF_UNIX socket of a campaign
+                                        # checker service
+                                        # (runner/checker_service.py);
+                                        # None = check in-process. Env
+                                        # JEPSEN_ETCD_TPU_CHECKER_SERVICE
+                                        # is the fallback source.
+        "force_kernel": False,          # disable the native-DFS size
+                                        # cutoff so every key is
+                                        # device-bound (campaign
+                                        # coalescing tests/bench on CPU;
+                                        # production leaves the measured
+                                        # routing alone)
     }
 
 

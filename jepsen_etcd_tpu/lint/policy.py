@@ -46,6 +46,15 @@ DET_WALLCLOCK_ALLOW = (
     "runner/trace.py",
     "runner/test_runner.py",
     "runner/store.py",
+    "runner/campaign.py",        # pool orchestration: wall-clock is
+                                 # sweep accounting, never verdict
+                                 # input (verdicts come from workers'
+                                 # run_test)
+    "runner/checker_service.py",  # socket I/O + coalescing-tick
+                                  # timing; the device verdicts it
+                                  # returns are pure functions of the
+                                  # shipped packs (THR still applies
+                                  # to its reader/dispatcher threads)
     "db/local.py",
     "db/fake_etcd.py",
     "sut/*",            # gateway bridges: readiness deadlines against

@@ -574,6 +574,69 @@ def ensure_frames(p: Packed) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Packed wire format (pack-once, serialize-packed)
+#
+# The checker-service protocol (runner/checker_service.py) ships
+# host-packed histories between processes: the runner packs ONCE, the
+# service deserializes and dispatches. Only the compact per-op vectors
+# travel (~32 B/op) — the [R, W(, W|I)] frame tables are exactly the
+# lazy fields ensure_frames rebuilds deterministically from them, so
+# re-deriving on the receiving side is both cheaper than shipping
+# (~512 B/op) and bit-identical (pinned by tests/test_checker_service).
+
+#: the lazy frame tables ensure_frames materializes — never serialized
+FRAME_FIELDS = frozenset((
+    "static_ok", "f_code", "a1", "a2", "ver", "pred_frame", "upd_mask",
+    "ceil_frame", "i_static_ok", "ipred_frame",
+))
+
+
+def serialize_packed(p: Packed) -> bytes:
+    """One Packed -> bytes: a JSON header (scalars + array manifest)
+    followed by the raw C-contiguous array payloads, no pickle."""
+    import dataclasses
+    import json as _json
+    scalars: dict = {}
+    arrays: list = []
+    blobs: list = []
+    for f in dataclasses.fields(Packed):
+        if f.name in FRAME_FIELDS:
+            continue
+        v = getattr(p, f.name)
+        if v is None or isinstance(v, (bool, int, str)):
+            scalars[f.name] = v
+        else:
+            a = np.ascontiguousarray(v)
+            arrays.append([f.name, a.dtype.str, list(a.shape)])
+            blobs.append(a.tobytes())
+    head = _json.dumps({"v": 1, "scalars": scalars,
+                        "arrays": arrays}).encode()
+    return head + b"\n" + b"".join(blobs)
+
+
+def deserialize_packed(buf: bytes) -> Packed:
+    """Inverse of serialize_packed. The frame tables stay lazy; any
+    consumer that needs them calls ensure_frames (pad_tables does)."""
+    import json as _json
+    nl = buf.index(b"\n")
+    head = _json.loads(buf[:nl].decode())
+    if head.get("v") != 1:
+        raise ValueError(f"unknown Packed wire version {head.get('v')}")
+    p = Packed(ok=False)
+    for name, v in head["scalars"].items():
+        setattr(p, name, v)
+    off = nl + 1
+    for name, dtype, shape in head["arrays"]:
+        dt = np.dtype(dtype)
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        a = np.frombuffer(buf, dtype=dt, count=n,
+                          offset=off).reshape(shape).copy()
+        off += n * dt.itemsize
+        setattr(p, name, a)
+    return p
+
+
+# ---------------------------------------------------------------------------
 # batched SoA packing (the key-DP axis' host-side hot path)
 
 
@@ -1936,7 +1999,15 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
     K = len(idxs)
     devs = jax.devices()
     n_dev = len(devs)
-    k_pad = -(-K // n_dev) * n_dev  # shard the key axis evenly
+    # shard the key axis evenly, padded to a power-of-two per-device
+    # count so jit caches stay warm across varying group sizes (the
+    # campaign checker service coalesces packs from many runs per
+    # tick, so K varies tick to tick; padding keys have R=0 and their
+    # lanes are dropped below — verdicts never see the pad)
+    per_dev = 1
+    while per_dev * n_dev < K:
+        per_dev *= 2
+    k_pad = per_dev * n_dev
     per_key = [pad_tables(packs[i], r_pad, info) for i in idxs]
     stacked = {}
     for name in per_key[0]:

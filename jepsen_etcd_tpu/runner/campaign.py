@@ -1,0 +1,216 @@
+"""Fleet campaign driver: seeds x workloads x nemeses over a process pool.
+
+The reference's ``test-all`` sweeps its matrix serially
+(etcd.clj:226-244); at fleet scale the sweep IS the workload, so this
+driver fans the expanded matrix over a bounded pool of spawned worker
+processes (one ``run_test`` per spec, per-run store dirs under the
+shared base — ``make_store_dir`` claims ids atomically) and, when the
+checker service is on, hosts ONE device-owning
+``runner/checker_service.CheckerService`` whose socket every worker's
+checker submits packed histories to — device dispatches are paid per
+(bucket, width, tick), not per run (PERF.md §campaign has the
+amortization accounting).
+
+Workers are SPAWNED, never forked: every worker initializes its own
+jax runtime, and forking a process with live device state (or live
+threads — the service, telemetry writers) is undefined. The spawn
+import cost (~seconds) is paid once per pool slot and amortizes over
+the campaign.
+
+Artifacts: the campaign itself owns a store dir
+(``store/<name>/<id>/``) holding ``campaign.json`` (per-run rows +
+failure summary + service stats) and ``telemetry.jsonl``
+(``campaign.*`` counters, one ``campaign.run`` event per run, and the
+service's counters folded in at the end). ``serve.py /aggregate``
+reads these for the perf-trends-across-campaigns section.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+from .store import _scrub, link_latest, make_store_dir
+from .telemetry import Telemetry
+
+logger = logging.getLogger("jepsen_etcd_tpu.campaign")
+
+
+def campaign_specs(base_opts: dict, workloads: list,
+                   nemeses: list, runs_per_cell: int = 1,
+                   seed0: int = 0) -> list[dict]:
+    """Expand the test-all matrix into one spec per run. Seeds are
+    distinct across the whole campaign (seed0 + running index) so no
+    two runs replay the same history."""
+    specs = []
+    for nem in nemeses:
+        for wl in workloads:
+            for i in range(runs_per_cell):
+                opts = dict(base_opts)
+                opts.update({"workload": wl, "nemesis": list(nem),
+                             "seed": seed0 + len(specs)})
+                specs.append({"index": len(specs), "opts": opts})
+    return specs
+
+
+def _pool_run(spec: dict) -> dict:
+    """One campaign run, executed inside a pool worker (top-level so
+    spawn can pickle it by module path). Returns a compact summary row
+    — never the history — so result transfer stays cheap."""
+    opts = dict(spec["opts"])
+    row: dict = {"index": spec["index"], "workload": opts.get("workload"),
+                 "nemesis": opts.get("nemesis"), "seed": opts.get("seed")}
+    try:
+        from ..compose import etcd_test
+        from .test_runner import run_test
+        test = etcd_test(opts)
+        out = run_test(test)
+    except NotImplementedError as e:
+        row.update(status="skipped", error=str(e))
+        return row
+    except Exception as e:  # a crashed run must not kill the sweep
+        logger.exception("campaign run %s failed", spec["index"])
+        row.update(status="error", error=repr(e))
+        return row
+    tel = (out.get("results") or {}).get("telemetry") or {}
+    counters = tel.get("counters") or {}
+    phases = tel.get("phases") or {}
+    row.update(
+        status="done", valid=out["valid?"], dir=out["dir"],
+        ops=len(out["history"]), wall_s=round(out["wall-seconds"], 3),
+        gen_ops_per_s=counters.get("generate.ops_per_s"),
+        check_s=round(phases.get("check", 0.0), 4),
+        dispatches=int(counters.get("wgl.dispatches", 0)
+                       + counters.get("mxu.dispatches", 0)),
+        service_fallbacks=int(counters.get("service.fallback", 0)),
+        service_shipped=int(counters.get("service.shipped", 0)),
+        engines={k[len("engine."):]: v for k, v in counters.items()
+                 if k.startswith("engine.")},
+    )
+    return row
+
+
+def _expected_pass(workload: str) -> bool:
+    from ..workloads import WORKLOADS_EXPECTED_TO_PASS
+    return workload in WORKLOADS_EXPECTED_TO_PASS
+
+
+def _tally_row(tel: Telemetry, row: dict) -> Optional[tuple]:
+    """Count one finished row into the campaign telemetry; returns a
+    failure tuple when the row should fail the campaign (the test-all
+    exit-code contract: expected-to-pass workloads must pass; sweeps
+    record skips and move on)."""
+    status = row.get("status")
+    tel.event("campaign.run", workload=row.get("workload"),
+              nemesis=",".join(row.get("nemesis") or []),
+              seed=row.get("seed"), status=status,
+              valid=row.get("valid"))
+    if status == "skipped":
+        tel.counter("campaign.skipped")
+        return None
+    if status == "error":
+        tel.counter("campaign.errors")
+        return (row.get("workload"), row.get("nemesis"),
+                row.get("error"))
+    tel.counter("campaign.completed")
+    if row.get("valid") is not True and _expected_pass(row["workload"]):
+        tel.counter("campaign.failed")
+        return (row["workload"], row["nemesis"], row.get("valid"))
+    return None
+
+
+def run_campaign(specs: list[dict], *, pool: int = 4,
+                 service: bool = True, service_tick_s: float = 0.05,
+                 store_base: str = "store", name: str = "campaign",
+                 start_method: str = "spawn",
+                 on_row=None) -> dict:
+    """Run a campaign: every spec through the pool, one shared checker
+    service (optional), one summary. ``pool=0`` runs specs inline in
+    this process (the bench serial baseline). Returns the summary dict
+    also written to ``<campaign dir>/campaign.json``."""
+    t0 = time.monotonic()
+    cdir = make_store_dir(store_base, name)
+    tel = Telemetry(os.path.join(cdir, "telemetry.jsonl"))
+    svc = None
+    failures: list = []
+    rows: list = [None] * len(specs)
+    service_stats = None
+    try:
+        if service:
+            from .checker_service import CheckerService
+            svc = CheckerService(tick_s=service_tick_s).start()
+        run_specs = []
+        for i, s in enumerate(specs):
+            s = dict(s)
+            s.setdefault("index", i)
+            opts = dict(s["opts"])
+            # runs store as siblings of the campaign dir (same base),
+            # so the serve.py run index and rotation see them
+            opts.setdefault("store_base", store_base)
+            if svc is not None:
+                opts["checker_service"] = svc.path
+            s["opts"] = opts
+            run_specs.append(s)
+        tel.counter("campaign.runs", len(run_specs))
+        with tel.span("campaign.sweep", runs=len(run_specs),
+                      pool=pool, service=bool(svc)):
+            if pool and pool > 0:
+                import concurrent.futures as cf
+                import multiprocessing as mp
+                ctx = mp.get_context(start_method)
+                with cf.ProcessPoolExecutor(max_workers=pool,
+                                            mp_context=ctx) as ex:
+                    futs = [ex.submit(_pool_run, s) for s in run_specs]
+                    for fut in cf.as_completed(futs):
+                        row = fut.result()
+                        rows[row["index"]] = row
+                        fail = _tally_row(tel, row)
+                        if fail is not None:
+                            failures.append(fail)
+                        if on_row is not None:
+                            on_row(row)
+            else:
+                for s in run_specs:
+                    row = _pool_run(s)
+                    rows[row["index"]] = row
+                    fail = _tally_row(tel, row)
+                    if fail is not None:
+                        failures.append(fail)
+                    if on_row is not None:
+                        on_row(row)
+        if svc is not None:
+            service_stats = svc.stats()
+    finally:
+        if svc is not None:
+            svc.close()
+    if service_stats is not None:
+        # fold the service's counters (service.* coalescing accounting
+        # AND the wgl./mxu. dispatch counters its device work accrued)
+        # into the campaign telemetry: one file proves the
+        # dispatches-per-(bucket, width, tick) bar
+        for cname, value in (service_stats.get("counters") or {}).items():
+            tel.counter(cname, value,
+                        mode="max" if cname == "service.batch_occupancy"
+                        else "sum")
+    summary = {
+        "name": name, "dir": cdir, "count": len(specs),
+        "pool": pool,
+        "valid?": not failures,
+        "failures": failures,
+        "runs": rows,
+        "wall_s": round(time.monotonic() - t0, 3),
+        "service": None if service_stats is None else {
+            "socket": svc.path, **service_stats},
+        "telemetry": tel.summary(),
+    }
+    with open(os.path.join(cdir, "campaign.json"), "w") as f:
+        json.dump(_scrub(summary), f, indent=2, default=repr)
+    tel.close()
+    link_latest(cdir)
+    logger.info(
+        "campaign %s: %d runs, %d failures, %.1f s (dir %s)",
+        name, len(specs), len(failures), summary["wall_s"], cdir)
+    return summary

@@ -92,22 +92,45 @@ def rotate_store(base: str, keep_dir: str = None,
     return removed
 
 
+def _next_run_id(tdir: str) -> int:
+    # max+1, NOT count: rotation deletes the lowest-numbered (oldest)
+    # runs, so a count could collide with a surviving higher id and
+    # silently overwrite its artifacts. Suffixed ids ("00007-1234abcd",
+    # the concurrent-creation escape hatch below) count by their
+    # numeric prefix.
+    existing = os.listdir(tdir) if os.path.isdir(tdir) else []
+    ids = [int(e.split("-")[0]) for e in existing
+           if e.split("-")[0].isdigit()]
+    return max(ids) + 1 if ids else 0
+
+
 def make_store_dir(base: str, test_name: str) -> str:
     """Create the next run dir. `latest` symlinks are NOT repointed here
     — the dir is made before the run executes (debug provenance needs
     its name), and a crashed run must not leave `latest` dangling at an
-    empty dir; save_run repoints them once artifacts exist."""
-    os.makedirs(base, exist_ok=True)
-    existing = sorted(os.listdir(os.path.join(base, test_name))) \
-        if os.path.isdir(os.path.join(base, test_name)) else []
-    # max+1, NOT count: rotation deletes the lowest-numbered (oldest)
-    # runs, so a count could collide with a surviving higher id and
-    # silently overwrite its artifacts
-    ids = [int(e) for e in existing if e.isdigit()]
-    run_id = f"{(max(ids) + 1 if ids else 0):05d}"
-    path = os.path.join(base, test_name, run_id)
-    os.makedirs(path, exist_ok=True)
-    return path
+    empty dir; save_run repoints them once artifacts exist.
+
+    Concurrency-safe: campaign pool workers (runner/campaign.py) create
+    run dirs under one test name simultaneously, so the bare
+    list-then-max id claim races. The claim itself is an ATOMIC
+    ``os.mkdir`` (never ``exist_ok=True``, which would silently hand
+    two runs the same artifact dir); a loser re-lists and retries, and
+    after a few lost races appends a pid+uuid suffix that cannot
+    collide."""
+    import uuid
+    tdir = os.path.join(base, test_name)
+    os.makedirs(tdir, exist_ok=True)
+    for attempt in range(8):
+        run_id = f"{_next_run_id(tdir):05d}"
+        if attempt >= 4:
+            run_id += f"-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        path = os.path.join(tdir, run_id)
+        try:
+            os.mkdir(path)
+            return path
+        except FileExistsError:
+            continue  # lost the claim race; re-list and retry
+    raise OSError(f"could not claim a run dir under {tdir}")
 
 
 def link_latest(store_dir: str) -> None:

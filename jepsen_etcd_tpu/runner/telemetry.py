@@ -79,6 +79,9 @@ REGISTRY = {
         "closure.host",
         "stream.chunk",
         "stream.finalize",
+        "campaign.sweep",     # runner/campaign.py: the whole pool pass
+        "service.tick",       # runner/checker_service.py: one coalesced
+                              # device dispatch window
     ),
     "counters": (
         "generate.ops_per_s",
@@ -99,9 +102,35 @@ REGISTRY = {
         "wgl.max-frontier",
         "wgl.host-spill",
         "mxu.dispatches",
+        "campaign.runs",          # runner/campaign.py sweep accounting
+        "campaign.completed",
+        "campaign.failed",
+        "campaign.skipped",
+        "campaign.errors",
+        "service.requests",       # runner/checker_service.py batching:
+        "service.submitted",      # packs received across all runners
+        "service.coalesced",      # packs beyond the first per group
+        "service.ticks",          # dispatch windows run
+        "service.group_ticks",    # sum of (bucket, width) groups/tick
+                                  # == the dispatch budget the coalescer
+                                  # is held to (~1 dispatch per group)
+        "service.batch_occupancy",  # max packs in one tick (mode=max)
+        "service.queue_wait_s",   # total submit->dispatch wait
+        "service.fallback",       # runner-side degradations to
+                                  # in-process checking
+        "service.checks",         # runner-side: service round-trips
+                                  # that returned verdicts
+        "service.shipped",        # runner-side packs shipped; summed
+                                  # over a campaign's runs this equals
+                                  # the service's service.submitted
+        "independent.keys",       # per-key fanout of the independent
+                                  # split (the producer side of the
+                                  # batching axis)
     ),
     "events": (
         "telemetry.dropped",
+        "campaign.run",           # one completed campaign run (attrs:
+                                  # workload, nemesis, seed, valid)
     ),
 }
 

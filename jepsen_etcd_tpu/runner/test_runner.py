@@ -265,7 +265,13 @@ def _analyze_and_save(test: dict, history, store_dir: str, cluster,
     cluster-derived logs (the local control plane collects its own)."""
     logger.info("Analyzing %d ops (history in %s)", len(history), store_dir)
     tel = telemetry.current()
-    with tel.span("phase:check", ops=len(history)):
+    # service=True means device-bound checks may ship to a shared
+    # campaign checker service — this run's check wall time then
+    # includes socket round-trip + coalescing-tick queue wait, not
+    # just local device work (see service.queue_wait_s on the
+    # service side)
+    with tel.span("phase:check", ops=len(history),
+                  service=bool(test.get("checker_service"))):
         results = test["checker"].check(test, history,
                                         {"store_dir": store_dir})
     if task_leak is not None:

@@ -117,6 +117,72 @@ def _run_rows(store_base: str) -> list[dict]:
     return rows
 
 
+def _campaign_rows(store_base: str) -> list[dict]:
+    """Campaign summaries under the store: every
+    ``<store>/<name>/<id>/campaign.json`` written by
+    runner/campaign.run_campaign. (Campaign dirs carry no
+    history.jsonl, so the run index never lists them — this is their
+    only dashboard surface.) Sorted oldest-first: the table reads as a
+    trend over successive campaigns."""
+    rows = []
+    try:
+        names = sorted(os.listdir(store_base))
+    except OSError:
+        return rows
+    for name in names:
+        ndir = os.path.join(store_base, name)
+        if not os.path.isdir(ndir):
+            continue
+        try:
+            ids = sorted(os.listdir(ndir))
+        except OSError:
+            continue
+        for rid in ids:
+            if os.path.islink(os.path.join(ndir, rid)):
+                continue  # the `latest` convenience symlink
+            cpath = os.path.join(ndir, rid, "campaign.json")
+            summary = _load_json(cpath)
+            if not isinstance(summary, dict) or "runs" not in summary:
+                continue
+            runs = [r for r in (summary.get("runs") or [])
+                    if isinstance(r, dict)]
+            done = [r for r in runs if r.get("status") == "done"]
+            rates = [r["gen_ops_per_s"] for r in done
+                     if isinstance(r.get("gen_ops_per_s"),
+                                   (int, float))]
+            sctr = ((summary.get("service") or {}).get("counters")
+                    or {})
+            svc_disp = sum(int(sctr.get(k, 0) or 0)
+                           for k in ("wgl.dispatches",
+                                     "mxu.dispatches"))
+            local_disp = sum(int(r.get("dispatches") or 0)
+                             for r in done)
+            try:
+                mtime = os.path.getmtime(cpath)
+            except OSError:
+                mtime = 0
+            rows.append({
+                "dir": os.path.relpath(os.path.dirname(cpath),
+                                       store_base),
+                "mtime": mtime, "name": summary.get("name", name),
+                "count": summary.get("count"),
+                "pool": summary.get("pool"),
+                "valid?": summary.get("valid?", "?"),
+                "wall_s": summary.get("wall_s"),
+                "gen_rate": (sum(rates) / len(rates)) if rates
+                else None,
+                "check_s": sum(r.get("check_s") or 0 for r in done),
+                "dispatches": svc_disp + local_disp,
+                "submitted": sctr.get("service.submitted"),
+                "group_ticks": sctr.get("service.group_ticks"),
+                "occupancy": sctr.get("service.batch_occupancy"),
+                "fallbacks": sum(int(r.get("service_fallbacks") or 0)
+                                 for r in done),
+            })
+    rows.sort(key=lambda r: r["mtime"])
+    return rows
+
+
 def _phase_bar(phases: dict) -> str:
     """A stacked horizontal bar of the run's phase wall times."""
     total = sum(v for v in phases.values()
@@ -233,6 +299,46 @@ def aggregate_html(store_base: str) -> str:
                + " ".join(f"<span class='bar' style='width:12px;"
                           f"background:{c}'></span> {html.escape(n)}"
                           for n, c in _PHASES) + "</p>")
+
+    # -- campaign perf trends across rounds ----------------------------------
+    camps = _campaign_rows(store_base)
+    if camps:
+        out.append(
+            "<h2>Campaign perf trends</h2>"
+            "<p class='dim'>successive campaigns, oldest first — "
+            "dispatch amortization is submitted packs vs batched "
+            "device dispatches (1 per (bucket, width, tick); "
+            "PERF.md §campaign)</p>"
+            "<table><tr><th>campaign</th><th>time</th><th>runs</th>"
+            "<th>pool</th><th>valid?</th><th>wall</th>"
+            "<th>gen ops/s</th><th>check wall</th>"
+            "<th>dispatches</th><th>amortization</th></tr>")
+        for c in camps:
+            when = time.strftime("%Y-%m-%d %H:%M",
+                                 time.localtime(c["mtime"]))
+            rate = c["gen_rate"]
+            rate_td = (f"<td>{rate:,.0f}</td>"
+                       if isinstance(rate, (int, float))
+                       else "<td class='dim'>—</td>")
+            if c["submitted"]:
+                amort = (f"{c['submitted']} packs &rarr; "
+                         f"{c['group_ticks']} dispatches, "
+                         f"occupancy&nbsp;{c['occupancy']}")
+                if c["fallbacks"]:
+                    amort += (f" <span class='bad'>"
+                              f"({c['fallbacks']} fallbacks)</span>")
+            else:
+                amort = "<span class='dim'>per-run checking</span>"
+            out.append(
+                f'<tr><td><a href="/{quote(c["dir"])}/?files">'
+                f'{html.escape(c["dir"])}</a></td>'
+                f"<td>{html.escape(when)}</td>"
+                f"<td>{c['count']}</td><td>{c['pool']}</td>"
+                f"<td>{_badge(c['valid?'])}</td>"
+                f"<td>{c['wall_s']}s</td>{rate_td}"
+                f"<td>{c['check_s']:.2f}s</td>"
+                f"<td>{c['dispatches']}</td><td>{amort}</td></tr>")
+        out.append("</table>")
 
     # -- failure dedupe by verdict signature ---------------------------------
     failing = [r for r in rows if r["valid?"] is not True]

@@ -16,7 +16,7 @@ from ..client import with_errors
 from ..generators import independent, mix, reserve, limit
 from ..models import VersionedRegister
 from ..checkers import compose, independent_checker
-from ..checkers.tpu_linearizable import TPULinearizableChecker
+from ..checkers.tpu_linearizable import CPU_CUTOFF, TPULinearizableChecker
 from .base import WorkloadClient
 
 
@@ -81,8 +81,13 @@ def workload(opts: dict) -> dict:
             # (the positioned timeline renders at the top of the stack,
             # compose.py — a per-key subhistory would lose the nemesis
             # bands and clobber timeline.html once per key)
+            # force_kernel pins the kernel path (no native-DFS size
+            # cutoff): campaign coalescing tests/bench need tiny sim
+            # histories to be device-bound even on CPU CI
             "linear": TPULinearizableChecker(
-                lambda: VersionedRegister(0, None)),
+                lambda: VersionedRegister(0, None),
+                cpu_cutoff=None if opts.get("force_kernel")
+                else CPU_CUTOFF),
         })),
         "generator": independent.concurrent_generator(
             group,

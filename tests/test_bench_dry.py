@@ -49,6 +49,14 @@ def test_dry_streaming_cell():
     assert cell["ops"] > 0
 
 
+def test_dry_campaign_cell():
+    res = run_dry("--cell", "campaign_amortization")
+    cell = res["dry"]["campaign_amortization"]
+    assert cell["ok"] is True and cell["check"] == "_dry_campaign"
+    assert cell["packs"] == 2
+    assert cell["verdicts_identical"] is True
+
+
 def test_dry_rejects_unknown_cell():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     out = subprocess.run(

@@ -1,0 +1,180 @@
+"""Campaign driver e2e (runner/campaign.py): pooled fan-out, per-run
+stores, the exit-code contract, and — the headline — cross-run dispatch
+amortization through the shared checker service, with every service
+verdict bit-identical to an in-process re-check of the same stored
+history.
+"""
+
+import json
+import os
+import threading
+
+from jepsen_etcd_tpu.forensics import load_history
+from jepsen_etcd_tpu.runner.campaign import (campaign_specs,
+                                             run_campaign)
+from jepsen_etcd_tpu.runner.store import make_store_dir
+
+#: verdict projection compared between the service-checked run and the
+#: in-process re-check (metadata like "rungs"/"batched" legitimately
+#: varies with group composition; tests/test_checker_service.py pins
+#: the same projection at the wgl layer)
+PROJECTION = ("valid?", "waves", "peak-frontier", "ops", "info-ops",
+              "op", "error", "stuck-at-depth")
+
+
+def test_campaign_specs_expand_with_distinct_seeds():
+    specs = campaign_specs({"rate": 5.0}, ["register", "set"],
+                           [[], ["kill"]], runs_per_cell=3, seed0=10)
+    assert len(specs) == 12
+    assert [s["index"] for s in specs] == list(range(12))
+    seeds = [s["opts"]["seed"] for s in specs]
+    assert seeds == list(range(10, 22))
+    assert {s["opts"]["workload"] for s in specs} == {"register", "set"}
+
+
+def test_store_dirs_are_collision_safe(tmp_path):
+    """Concurrent make_store_dir calls (the pooled campaign's worker
+    processes racing on one base) must never hand two callers the same
+    directory."""
+    base = str(tmp_path)
+    dirs: list = []
+    lock = threading.Lock()
+
+    def claim():
+        d = make_store_dir(base, "race")
+        with lock:
+            dirs.append(d)
+
+    threads = [threading.Thread(target=claim) for _ in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(dirs) == 16
+    assert len(set(dirs)) == 16, "two callers claimed one run dir"
+    for d in dirs:
+        assert os.path.isdir(d)
+
+
+def test_campaign_pool_e2e(tmp_path):
+    """12 sim runs over a pool of 3 spawned workers: every run gets
+    its own store dir with saved artifacts, rows come back indexed,
+    and the aggregate verdict follows the test-all exit-code
+    contract."""
+    # rate high enough that every seed lands >=1 ok op per f (else the
+    # stats checker honestly says "unknown", which fails the
+    # expected-pass contract); sim runs are seed-deterministic, so
+    # these exact opts were verified all-True once and stay that way
+    base = {"time_limit": 1, "rate": 100.0,
+            "nodes": ["n1", "n2", "n3"]}
+    specs = campaign_specs(base, ["register"], [[], ["kill"]],
+                           runs_per_cell=6, seed0=7)
+    assert len(specs) == 12
+    summary = run_campaign(specs, pool=3, service=False,
+                           store_base=str(tmp_path), name="e2e")
+    assert summary["valid?"] is True
+    assert summary["failures"] == []
+    rows = summary["runs"]
+    assert [r["index"] for r in rows] == list(range(12))
+    assert all(r["status"] == "done" and r["valid"] is True
+               for r in rows)
+    dirs = {r["dir"] for r in rows}
+    assert len(dirs) == 12, "runs shared a store dir"
+    for r in rows:
+        assert os.path.isfile(os.path.join(r["dir"], "results.json"))
+        assert os.path.isfile(os.path.join(r["dir"], "history.jsonl"))
+        assert r["ops"] > 0
+    ctr = (summary["telemetry"].get("counters") or {})
+    assert ctr.get("campaign.runs") == 12
+    assert ctr.get("campaign.completed") == 12
+    assert not ctr.get("campaign.failed")
+    cjson = os.path.join(summary["dir"], "campaign.json")
+    assert json.load(open(cjson))["count"] == 12
+    # the campaign surfaces on the aggregate dashboard
+    from jepsen_etcd_tpu.serve import aggregate_html
+    page = aggregate_html(str(tmp_path))
+    assert "Campaign perf trends" in page and "e2e/" in page
+
+
+def test_campaign_counts_errors_and_fails(tmp_path):
+    """A crashing run is one error row, not a dead sweep — and it
+    fails the campaign."""
+    ok = {"opts": {"workload": "register", "time_limit": 1,
+                   "rate": 40.0, "seed": 3,
+                   "nodes": ["n1", "n2", "n3"]}}
+    bad = {"opts": {"workload": "no-such-workload", "time_limit": 1,
+                    "rate": 40.0, "seed": 4,
+                    "nodes": ["n1", "n2", "n3"]}}
+    summary = run_campaign([ok, bad], pool=0, service=False,
+                           store_base=str(tmp_path), name="mixed")
+    rows = summary["runs"]
+    assert rows[0]["status"] == "done" and rows[0]["valid"] is True
+    assert rows[1]["status"] == "error"
+    assert summary["valid?"] is False
+    assert len(summary["failures"]) == 1
+    ctr = (summary["telemetry"].get("counters") or {})
+    assert ctr.get("campaign.completed") == 1
+    assert ctr.get("campaign.errors") == 1
+
+
+def _recheck_locally(run_dir: str) -> dict:
+    """Re-run the run's own checker in-process (no service) over its
+    saved history; returns {key: linear-verdict-projection}."""
+    from jepsen_etcd_tpu.workloads.register import workload
+    test = json.load(open(os.path.join(run_dir, "test.json")))
+    test.pop("checker_service", None)
+    checker = workload(test)["checker"]
+    res = checker.check(test, load_history(run_dir))
+    return {str(k): {f: (v.get("linear") or {}).get(f)
+                     for f in PROJECTION}
+            for k, v in res["results"].items()}
+
+
+def test_campaign_coalescing_50_runs(tmp_path):
+    """The acceptance bar: a 50-run forced-kernel campaign through the
+    shared service coalesces every device-bound check into at most one
+    dispatch per (bucket, width, tick) — proven by the campaign's own
+    folded counters — and every stored verdict is bit-identical to an
+    in-process re-check of the same history."""
+    base = {"time_limit": 1, "rate": 100.0, "force_kernel": True,
+            "nodes": ["n1", "n2", "n3"]}
+    specs = campaign_specs(base, ["register"], [[]],
+                           runs_per_cell=50, seed0=100)
+    summary = run_campaign(specs, pool=4, service=True,
+                           service_tick_s=0.05,
+                           store_base=str(tmp_path), name="coalesce")
+    assert summary["valid?"] is True, summary["failures"]
+    rows = summary["runs"]
+    assert len(rows) == 50
+    assert all(r["status"] == "done" and r["valid"] is True
+               for r in rows)
+    ctr = (summary["telemetry"].get("counters") or {})
+    assert ctr.get("campaign.completed") == 50
+
+    # -- dispatch-amortization ledger ------------------------------------
+    submitted = ctr.get("service.submitted", 0)
+    group_ticks = ctr.get("service.group_ticks", 0)
+    dispatches = (ctr.get("wgl.dispatches", 0)
+                  + ctr.get("mxu.dispatches", 0))
+    assert submitted >= 50, ctr     # every run shipped >= 1 pack
+    assert 0 < group_ticks < submitted, ctr   # coalescing happened
+    # <= 1 device launch per (bucket, width, tick): the tentpole bar
+    assert dispatches <= group_ticks, ctr
+    assert ctr.get("service.batch_occupancy", 0) >= 2, ctr
+    assert not ctr.get("service.fallback"), ctr
+    # workers shipped ALL device work — no local dispatches, and the
+    # producer-side ledger balances: packs shipped by the runs equal
+    # packs the service says it received
+    assert sum(r["dispatches"] for r in rows) == 0
+    assert sum(r["service_fallbacks"] for r in rows) == 0
+    assert sum(r["service_shipped"] for r in rows) == submitted
+
+    # -- verdict bit-identity vs in-process re-check ---------------------
+    for r in rows:
+        stored = json.load(
+            open(os.path.join(r["dir"], "results.json")))
+        got = {str(k): {f: (v.get("linear") or {}).get(f)
+                        for f in PROJECTION}
+               for k, v in stored["workload"]["results"].items()}
+        want = _recheck_locally(r["dir"])
+        assert got == want, r["dir"]

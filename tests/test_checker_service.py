@@ -1,0 +1,226 @@
+"""Checker service (runner/checker_service.py): wire format, verdict
+identity, coalescing accounting, and degradation.
+
+The service's soundness contract is that shipping a packed history over
+the socket changes NOTHING about its verdict: the service runs the same
+``wgl.check_packed_batch`` the runner would, so the verdict projection
+(validity, failure site, wave/frontier accounting) must be bit-identical
+to in-process checking — including invalid and info-heavy histories.
+Degradation must be silent and sound: a dead socket means the caller
+checks locally, never an error, never a changed verdict.
+"""
+
+import dataclasses
+import json
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from jepsen_etcd_tpu.core.history import History
+from jepsen_etcd_tpu.checkers.tpu_linearizable import TPULinearizableChecker
+from jepsen_etcd_tpu.ops import wgl
+from jepsen_etcd_tpu.runner import checker_service as svc_mod
+from jepsen_etcd_tpu.runner import telemetry
+from jepsen_etcd_tpu.runner.telemetry import Telemetry
+
+from test_wgl import gen_history
+
+#: the verdict projection the service must reproduce bit-identically;
+#: metadata ("rungs", "engine", "batched") legitimately differs with
+#: group composition (a pack alone rides the ladder; grouped, the
+#: vmapped kernel) — exactly as it already does between check_packed
+#: and check_packed_batch in-process
+PROJECTION = ("valid?", "waves", "peak-frontier", "ops", "info-ops",
+              "op", "error", "stuck-at-depth")
+
+
+def view(out: dict) -> dict:
+    return {k: out.get(k) for k in PROJECTION}
+
+
+def make_packs(seed, n, info_rate=0.1, corrupt=False):
+    rng = random.Random(seed)
+    packs = []
+    while len(packs) < n:
+        h = History(gen_history(rng, n_procs=rng.randint(2, 4),
+                                n_ops=rng.randint(8, 40),
+                                info_rate=info_rate, corrupt=corrupt))
+        p = wgl.pack_register_history(h)
+        if p.ok and p.R > 0:
+            packs.append(p)
+    return packs
+
+
+@pytest.fixture
+def service():
+    svc = svc_mod.CheckerService(tick_s=0.01).start()
+    yield svc
+    svc.close()
+    svc_mod.reset_clients()
+
+
+# -- wire format -------------------------------------------------------------
+
+def test_serialize_roundtrip_bit_identical():
+    for p in make_packs(3, 6, info_rate=0.2):
+        q = wgl.deserialize_packed(wgl.serialize_packed(p))
+        wgl.ensure_frames(p)
+        wgl.ensure_frames(q)
+        for fld in dataclasses.fields(type(p)):
+            x, y = getattr(p, fld.name), getattr(q, fld.name)
+            if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+                assert np.array_equal(x, y), fld.name
+                assert x.dtype == y.dtype, fld.name
+            else:
+                assert x == y, (fld.name, x, y)
+
+
+def test_deserialize_rejects_unknown_version():
+    buf = wgl.serialize_packed(make_packs(4, 1)[0])
+    head, _, blobs = buf.partition(b"\n")
+    h = json.loads(head)
+    h["v"] = 99
+    with pytest.raises(ValueError):
+        wgl.deserialize_packed(json.dumps(h).encode() + b"\n" + blobs)
+
+
+# -- verdict identity --------------------------------------------------------
+
+def test_service_verdicts_match_local_fuzz(service):
+    """Mixed valid/corrupt/info-heavy packs through the socket: every
+    verdict projection identical to in-process check_packed, singleton
+    and cross-history-batched requests alike."""
+    packs = (make_packs(11, 6, info_rate=0.15)
+             + make_packs(12, 4, corrupt=True)
+             + make_packs(13, 2, info_rate=0.5))
+    local = [wgl.check_packed(p) for p in packs]
+    assert any(out["valid?"] is False for out in local), \
+        "fuzz lost its invalid histories"
+    client = svc_mod.CheckerClient(service.path)
+    # one big request: the service batches across histories
+    outs = client.check(packs)
+    assert outs is not None
+    for got, want in zip(outs, local):
+        assert view(got) == view(want)
+    # singleton requests: the service's lone-pack ladder route
+    for p, want in zip(packs[:3], local[:3]):
+        got = client.check([p])
+        assert got is not None and view(got[0]) == view(want)
+    client.close()
+
+
+def test_service_coalesces_concurrent_clients(service):
+    """Requests from concurrent clients land in shared ticks: the
+    dispatch ledger shows every pack accounted for and device
+    launches bounded by (bucket, width) groups per tick, not by
+    request count."""
+    packs = make_packs(21, 8, info_rate=0.1)
+    local = [wgl.check_packed(p) for p in packs]
+    results = [None] * 4
+    # warm the dispatcher before timing-sensitive concurrency: the
+    # first tick pays jit compiles that would smear arrival windows
+    warm = svc_mod.CheckerClient(service.path)
+    assert warm.check(packs[:1]) is not None
+    warm.close()
+
+    def go(i):
+        c = svc_mod.CheckerClient(service.path)
+        results[i] = c.check(packs[2 * i: 2 * i + 2])
+        c.close()
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(4):
+        assert results[i] is not None
+        for got, want in zip(results[i], local[2 * i: 2 * i + 2]):
+            assert view(got) == view(want)
+    ctr = (service.stats().get("counters") or {})
+    assert ctr.get("service.requests") == 5, ctr
+    assert ctr.get("service.submitted") == 9, ctr
+    # every tick launches at most one dispatch per (bucket, width)
+    # group — the amortization bar (rung escalation could add more,
+    # but these shallow histories resolve on the first rung)
+    assert ctr.get("wgl.dispatches", 0) <= ctr.get("service.group_ticks"), ctr
+    assert ctr.get("service.ticks", 0) >= 1, ctr
+
+
+def test_resume_state_never_crosses_the_wire(service, monkeypatch):
+    """Device-array resume state (the spill=False overflow handshake)
+    must be stripped before the verdict is serialized — a client must
+    receive clean JSON it can re-run the spill from locally."""
+    pack = make_packs(31, 1)[0]
+
+    real = wgl.check_packed_batch
+
+    def overflowing(packs, **kw):
+        outs = real(packs, **kw)
+        for o in outs:
+            o["_resume"] = (object(), object(), 3)  # unserializable
+        return outs
+
+    monkeypatch.setattr(wgl, "check_packed_batch", overflowing)
+    client = svc_mod.CheckerClient(service.path)
+    outs = client.check([pack])
+    assert outs is not None
+    assert "_resume" not in outs[0]
+    assert view(outs[0]) == view(wgl.check_packed(pack))
+    client.close()
+
+
+# -- degradation -------------------------------------------------------------
+
+def test_checker_falls_back_when_service_down(tmp_path):
+    """A configured-but-dead endpoint degrades to in-process checking:
+    same verdict, one service.fallback counter, no error."""
+    rng = random.Random(41)
+    h = History(gen_history(rng, n_procs=3, n_ops=24, info_rate=0.1))
+    checker = TPULinearizableChecker(cpu_cutoff=None)
+    want = checker.check({}, h)
+    svc_mod.reset_clients()
+    tel = Telemetry()
+    prev = telemetry.current()
+    telemetry.set_current(tel)
+    try:
+        got = checker.check(
+            {"checker_service": str(tmp_path / "nope.sock")}, h)
+    finally:
+        telemetry.set_current(
+            prev if prev is not telemetry.NULL else None)
+        svc_mod.reset_clients()
+    assert view(got) == view(want)
+    ctr = (tel.summary().get("counters") or {})
+    assert ctr.get("service.fallback") == 1, ctr
+
+
+def test_client_cache_latches_broken(tmp_path):
+    svc_mod.reset_clients()
+    test = {"checker_service": str(tmp_path / "gone.sock")}
+    assert svc_mod.client_for(test) is None
+    # second lookup hits the latched None, no second connect attempt
+    assert svc_mod.client_for(test) is None
+    svc_mod.reset_clients()
+
+
+def test_service_survives_checker_exception(service, monkeypatch):
+    """A tick that raises must degrade (error reply -> client returns
+    None -> caller checks locally), and the NEXT request must succeed
+    — the service never wedges."""
+    pack = make_packs(51, 1)[0]
+
+    def boom(packs, **kw):
+        raise RuntimeError("injected tick failure")
+
+    real = wgl.check_packed_batch
+    monkeypatch.setattr(wgl, "check_packed_batch", boom)
+    client = svc_mod.CheckerClient(service.path)
+    assert client.check([pack]) is None
+    monkeypatch.setattr(wgl, "check_packed_batch", real)
+    outs = client.check([pack])
+    assert outs is not None
+    assert view(outs[0]) == view(wgl.check_packed(pack))
+    client.close()
