@@ -887,6 +887,87 @@ def bench_campaign_amortization():
                                  / max(svc["wall_s"], 1e-9), 2)}
 
 
+def _mean_op_latency_ms(h):
+    """Mean invoke->ok wall latency over client ops (ms), paired by
+    process. Returns (mean_ms, n_ok)."""
+    pend, lat = {}, []
+    for o in h.client_ops():
+        if o.get("type") == "invoke":
+            pend[o.get("process")] = o.get("time")
+        elif o.get("type") == "ok":
+            t0 = pend.pop(o.get("process"), None)
+            if t0 is not None and o.get("time") is not None:
+                lat.append((o["time"] - t0) / 1e6)
+    if not lat:
+        return None, 0
+    return sum(lat) / len(lat), len(lat)
+
+
+def _verdict_skeleton(results):
+    """The recursive valid?-only projection of a results tree: the
+    VERDICT with every timing/detail field stripped, so two runs can
+    be compared bit-for-bit on what they decided."""
+    if not isinstance(results, dict):
+        return None
+    out = {}
+    for k in sorted(results):
+        v = results[k]
+        if k == "valid?":
+            out[k] = v
+        elif isinstance(v, dict):
+            sub = _verdict_skeleton(v)
+            if sub:
+                out[k] = sub
+    return out
+
+
+def _net_runs(time_limit, rate, seed):
+    """The SAME single-node fake-etcd register run twice: direct, then
+    through the userspace proxy plane (net/). Single node keeps the
+    fake stub a linearizable register; the client hop is the proxied
+    path being measured either way."""
+    base = dict(client_type="http", db_mode="local", etcd_binary="fake",
+                nodes=["n1"], time_limit=time_limit, rate=rate,
+                seed=seed, snapshot_count=100_000)
+    d_test, d_out, d_s = run_workload("register", **base)
+    p_test, p_out, p_s = run_workload("register", net_proxy=True, **base)
+    assert d_test["db"].plane is None
+    assert p_test["db"].plane is not None
+    return (d_test, d_out, d_s), (p_test, p_out, p_s)
+
+
+def bench_net_overhead():
+    """Proxy-plane overhead cell (PR 11): a no-fault `--db local` run
+    direct vs proxied (--net-proxy), mean client op latency
+    head-to-head. Wall numbers are REPORTED, never asserted (userspace
+    splice cost rides host load); the asserted guarantee is
+    structural — the proxied run's verdict skeleton is bit-identical
+    to the direct run's, i.e. fronting every URL changes nothing a
+    checker can see."""
+    (d_test, d_out, _), (p_test, p_out, _) = _net_runs(
+        time_limit=8, rate=100, seed=41)
+    d_ms, d_n = _mean_op_latency_ms(d_out["history"])
+    p_ms, p_n = _mean_op_latency_ms(p_out["history"])
+    dsk = _verdict_skeleton(d_out["results"].get("workload"))
+    psk = _verdict_skeleton(p_out["results"].get("workload"))
+    assert dsk == psk, (dsk, psk)
+    stats = p_test["db"].plane.stats()
+    added = (p_ms - d_ms) if (p_ms is not None and d_ms is not None) \
+        else None
+    note(f"net-overhead: direct {d_ms:.2f}ms/{d_n} ops, proxied "
+         f"{p_ms:.2f}ms/{p_n} ops (added {added:+.2f}ms); "
+         f"plane={stats}")
+    return {"value": round(added, 3) if added is not None else None,
+            "unit": "added_ms_per_op",
+            "direct_ms": round(d_ms, 3), "proxied_ms": round(p_ms, 3),
+            "direct_ok_ops": d_n, "proxied_ok_ops": p_n,
+            "plane": stats, "verdicts_identical": True,
+            # overhead cell: vs_baseline is direct/proxied throughput
+            # ratio, ~1.0 when the plane is invisible
+            "vs_baseline": round(d_ms / max(p_ms, 1e-9), 2)
+            if d_ms is not None and p_ms is not None else None}
+
+
 CELLS = [("register_100", bench_register_100),
          ("engine_crossover", bench_engine_crossover),
          ("deep_wgl_4n_2000", bench_deep_wgl),
@@ -901,6 +982,7 @@ CELLS = [("register_100", bench_register_100),
          ("closure_scale_2048", bench_closure_scale),
          ("watch_edit_distance", bench_watch),
          ("streaming_overlap", bench_streaming_overlap),
+         ("net_overhead", bench_net_overhead),
          ("campaign_amortization", bench_campaign_amortization)]
 
 
@@ -1131,6 +1213,28 @@ def _dry_campaign():
             "verdicts_identical": True}
 
 
+def _dry_net_overhead():
+    """Tiny proxied run vs its direct twin: the plane actually fronted
+    the node's URLs (links counted, ports split listen-vs-advertise),
+    and the no-fault proxied verdict skeleton is BIT-identical to the
+    direct run's — the tier-1 guard that the proxy is invisible to
+    checkers."""
+    (d_test, d_out, _), (p_test, p_out, _) = _net_runs(
+        time_limit=3, rate=50, seed=_DRY_SEED)
+    plane = p_test["db"].plane
+    stats = plane.stats()
+    assert stats["links"] == 2, stats          # client + peer for n1
+    assert p_test["db"].proxy_ports["n1"] != p_test["db"].ports["n1"]
+    ctr = (p_out["results"].get("telemetry") or {}).get("counters") or {}
+    assert ctr.get("net.links") == 2, ctr
+    dsk = _verdict_skeleton(d_out["results"].get("workload"))
+    psk = _verdict_skeleton(p_out["results"].get("workload"))
+    assert dsk and dsk == psk, (dsk, psk)
+    assert psk.get("valid?") is True, psk
+    return {"ops": len(p_out["history"]), "links": stats["links"],
+            "verdicts_identical": True}
+
+
 DRY_CHECKS = {"register_100": _dry_register,
               "engine_crossover": _dry_register,
               "deep_wgl_4n_2000": _dry_register,
@@ -1145,6 +1249,7 @@ DRY_CHECKS = {"register_100": _dry_register,
               "closure_scale_2048": _dry_closure,
               "watch_edit_distance": _dry_watch,
               "streaming_overlap": _dry_streaming,
+              "net_overhead": _dry_net_overhead,
               "campaign_amortization": _dry_campaign,
               "register_10k": _dry_register}
 

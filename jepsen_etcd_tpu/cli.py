@@ -21,11 +21,12 @@ ALL_NEMESES = [
     ["pause", "admin"],
     ["kill", "admin"],
     ["partition", "admin"],
+    ["latency", "admin"],
     ["member", "admin"],
     ["bitflip-wal", "bitflip-snap", "admin"],
     ["bitflip-wal", "bitflip-snap", "kill"],
     ["admin", "bitflip-snap", "bitflip-wal", "pause", "kill", "partition",
-     "clock", "member"],
+     "latency", "clock", "member"],
 ]
 
 
@@ -43,7 +44,7 @@ def build_parser() -> argparse.ArgumentParser:
                        choices=sorted(workloads().keys()))
         s.add_argument("--nemesis", default="",
                        help="comma-separated faults: kill,pause,partition,"
-                            "clock,member,corrupt,admin,all,none")
+                            "latency,clock,member,corrupt,admin,all,none")
         s.add_argument("--nemesis-interval", type=float, default=5.0)
         s.add_argument("-r", "--rate", type=float, default=200.0)
         s.add_argument("--ops-per-key", type=int, default=200)
@@ -81,6 +82,12 @@ def build_parser() -> argparse.ArgumentParser:
         s.add_argument("--etcd-data-dir", default=None,
                        help="--db local: root for per-node data dirs "
                             "and logs (default: a fresh temp dir)")
+        s.add_argument("--net-proxy", action="store_true",
+                       help="--db local: front every peer/client URL "
+                            "with the userspace TCP proxy plane "
+                            "(net/plane.py) even when no network fault "
+                            "is requested; partition/latency faults "
+                            "raise it automatically")
         s.add_argument("--snapshot-count", type=int, default=100)
         s.add_argument("--unsafe-no-fsync", action="store_true",
                        help="ask the SUT not to fsync WAL appends "
@@ -187,7 +194,7 @@ SPECIAL_NEMESES = {  # etcd.clj:75-80
     "none": [],
     "corrupt": ["bitflip-wal", "bitflip-snap", "truncate-wal"],
     "all": ["admin", "pause", "kill", "bitflip-wal", "bitflip-snap",
-            "truncate-wal", "partition", "clock", "member"],
+            "truncate-wal", "partition", "latency", "clock", "member"],
 }
 
 
@@ -228,6 +235,7 @@ def opts_from_args(args) -> dict:
         "db_mode": db_mode,
         "etcd_binary": getattr(args, "etcd_binary", None),
         "etcd_data_dir": getattr(args, "etcd_data_dir", None),
+        "net_proxy": getattr(args, "net_proxy", False),
         "snapshot_count": args.snapshot_count,
         "unsafe_no_fsync": args.unsafe_no_fsync,
         "corrupt_check": args.corrupt_check,

@@ -77,21 +77,25 @@ def default_opts() -> dict:
                                         # coalescing tests/bench on CPU;
                                         # production leaves the measured
                                         # routing alone)
+        "net_proxy": False,             # --db local: front every peer/
+                                        # client URL with the userspace
+                                        # proxy plane (net/plane.py).
+                                        # Auto-set when partition or
+                                        # latency faults are requested.
     }
 
 
 #: faults the local control plane (db/local.py) can inject with plain
-#: process-level privileges
-LOCAL_FAULTS = {"kill", "pause", "member", "admin"}
+#: process-level privileges; partition + latency ride the userspace
+#: TCP proxy plane (net/plane.py), raised automatically when requested
+LOCAL_FAULTS = {"kill", "pause", "member", "admin", "partition",
+                "latency"}
 
-#: fault -> why `--db local` refuses it (each failure mode is specific
-#: and documented, not a blanket live-mode error; see README "Fault /
-#: privilege matrix")
+#: fault -> why `--db local` refuses it (each REMAINING failure mode is
+#: specific and documented, not a blanket live-mode error; see README
+#: "Fault / privilege matrix". Partition/latency used to live here —
+#: the net proxy plane closed that gap.)
 LOCAL_FAULT_REFUSALS = {
-    "partition": ("network partitions need a privileged netns/iptables "
-                  "layer (the reference isolates nodes with iptables "
-                  "over SSH); the process-level local control plane "
-                  "cannot reshape loopback traffic"),
     "clock": ("clock skew needs per-process time virtualization "
               "(CAP_SYS_TIME / libfaketime); the local control plane "
               "does not alter the host clock"),
@@ -101,6 +105,26 @@ LOCAL_FAULT_REFUSALS = {
 }
 LOCAL_FAULT_REFUSALS["bitflip-snap"] = LOCAL_FAULT_REFUSALS["bitflip-wal"]
 LOCAL_FAULT_REFUSALS["truncate-wal"] = LOCAL_FAULT_REFUSALS["bitflip-wal"]
+
+
+def fault_matrix(db_mode: str = "local") -> dict:
+    """fault -> {"supported": bool, "why": refusal-or-None} for the
+    given db mode; the README table and test_config_plane assert these
+    rows. Sim supports everything; live supports nothing (the cluster
+    is external)."""
+    from .nemesis.faults import KNOWN_FAULTS
+    rows = {}
+    for fault in sorted(KNOWN_FAULTS):
+        if db_mode == "live":
+            supported, why = False, "external cluster: no control plane"
+        elif db_mode == "local":
+            supported = fault in LOCAL_FAULTS
+            why = None if supported else LOCAL_FAULT_REFUSALS.get(
+                fault, "not implemented")
+        else:
+            supported, why = True, None
+        rows[fault] = {"supported": supported, "why": why}
+    return rows
 
 
 def _check_fault_support(db_mode: str, o: dict) -> None:
@@ -151,6 +175,10 @@ def etcd_test(opts: dict) -> dict:
             "--db sim has no live endpoints. Use --db live (external "
             "cluster) or --db local (locally spawned processes)")
     _check_fault_support(db_mode, o)
+    if db_mode == "local" and \
+            {"partition", "latency"} & set(o.get("nemesis") or []):
+        # network faults in local mode ride the userspace proxy plane
+        o["net_proxy"] = True
     if db_mode == "local":
         from .db.local import local_db
         o["db"] = local_db(o)

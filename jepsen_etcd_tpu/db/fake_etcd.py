@@ -20,6 +20,19 @@ concern (tests/test_live_etcd.py, gated on `shutil.which("etcd")`);
 process-control correctness is this stub's concern. Leadership is
 deterministic: every node reports leader = lowest member id.
 
+Quorum awareness (the one distributed behavior the stub does model, so
+userspace-proxy partitions are observable): in a multi-node roster each
+node listens on its peer port and runs a prober that round-trips a
+``FAKE-ETCD-PEER <name>\\n`` preamble through every roster peer URL —
+which, under ``--net-proxy``, routes through the target's ingress proxy
+where drop rules apply. A node that can see fewer than a majority of
+the roster reports leader=0 and refuses linearizable reads and writes
+with ``etcdserver: no leader`` (grpc code 14, the same wire shape real
+etcd emits), so a partitioned minority fails ops while the majority
+progresses, and healing restores it. Probe reads use short timeouts:
+a SIGSTOP'd node's kernel still completes TCP handshakes via the
+accept backlog, so only the reply round-trip distinguishes alive.
+
 Runs both ways:
     python -m jepsen_etcd_tpu.db.fake_etcd --name n1 ...
     python /path/to/fake_etcd.py --name n1 ...   (db/local.py default)
@@ -34,6 +47,7 @@ import argparse
 import os
 import pickle
 import signal
+import socket
 import sys
 import threading
 import time
@@ -47,6 +61,14 @@ from jepsen_etcd_tpu.sut.http_gateway import (  # noqa: E402
 from jepsen_etcd_tpu.sut.store import Store  # noqa: E402
 
 STORE_FILE = "member/snap/store.pickle"  # under the data dir
+
+#: peer-visibility probe cadence / per-peer reply deadline (short: a
+#: SIGSTOP'd peer still accepts via the kernel backlog, only the reply
+#: times out)
+PROBE_INTERVAL_S = 0.25
+PROBE_TIMEOUT_S = 1.0
+PEER_PREAMBLE = b"FAKE-ETCD-PEER "
+PEER_REPLY = b"FAKE-ETCD-OK "
 
 
 def _log(msg: str, level: str = "info") -> None:
@@ -97,6 +119,11 @@ def _url_port(url: str) -> int:
     return int(url.rsplit(":", 1)[1].rstrip("/"))
 
 
+def _url_host(url: str) -> str:
+    hostport = url.split("//", 1)[-1]
+    return hostport.rsplit(":", 1)[0] or "127.0.0.1"
+
+
 class FakeEtcd:
     def __init__(self, args: argparse.Namespace):
         self.args = args
@@ -118,6 +145,100 @@ class FakeEtcd:
         self._persist_lock = threading.Lock()
         self._stopping = threading.Event()
         self._srv = None
+        # peer visibility: name -> peer URL roster to probe, and the
+        # set of roster members this node can currently round-trip to
+        # (self included). Starts optimistic so a clean boot reports a
+        # leader before the first probe round completes.
+        self.roster = dict(roster)
+        self._peer_lock = threading.Lock()
+        self._visible = set(self.roster) or {args.name}
+        self._peer_srv: socket.socket = None
+        if len(self.roster) > 1:
+            self.state.quorum_check = self._has_quorum
+
+    # ---- peer visibility / quorum ------------------------------------------
+
+    def _has_quorum(self) -> bool:
+        with self._peer_lock:
+            visible = len(self._visible)
+        return visible >= len(self.roster) // 2 + 1
+
+    def _peer_answer(self, conn: socket.socket) -> None:
+        """Answer one probe: read the preamble, echo our name back.
+        The round trip crosses both proxy legs, so a one-way drop in
+        either direction degrades visibility correctly."""
+        try:
+            conn.settimeout(PROBE_TIMEOUT_S)
+            buf = b""
+            while b"\n" not in buf and len(buf) < 256:
+                chunk = conn.recv(256)
+                if not chunk:
+                    break
+                buf += chunk
+            if buf.startswith(PEER_PREAMBLE):
+                conn.sendall(PEER_REPLY
+                             + self.args.name.encode("utf-8") + b"\n")
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _peer_listen_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._peer_srv.accept()
+            except OSError:
+                return  # listener closed on shutdown
+            threading.Thread(target=self._peer_answer, args=(conn,),
+                             daemon=True).start()
+
+    def _probe_one(self, url: str) -> bool:
+        try:
+            with socket.create_connection(
+                    (_url_host(url), _url_port(url)),
+                    timeout=PROBE_TIMEOUT_S) as s:
+                s.settimeout(PROBE_TIMEOUT_S)
+                s.sendall(PEER_PREAMBLE
+                          + self.args.name.encode("utf-8") + b"\n")
+                buf = b""
+                while b"\n" not in buf and len(buf) < 256:
+                    chunk = s.recv(256)
+                    if not chunk:
+                        break
+                    buf += chunk
+                return buf.startswith(PEER_REPLY)
+        except OSError:
+            return False
+
+    def _probe_loop(self) -> None:
+        """Round-trip the preamble to every roster peer URL (under
+        --net-proxy these route through each target's ingress proxy,
+        where drop rules apply) and publish the visible set."""
+        while not self._stopping.wait(PROBE_INTERVAL_S):
+            seen = {self.args.name}
+            for name in sorted(self.roster):
+                if name == self.args.name:
+                    continue
+                if self._probe_one(self.roster[name]):
+                    seen.add(name)
+            with self._peer_lock:
+                self._visible = seen
+
+    def _start_peer_plane(self) -> None:
+        port = _url_port(self.args.listen_peer_urls)
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(64)
+        self._peer_srv = srv
+        threading.Thread(target=self._peer_listen_loop,
+                         daemon=True).start()
+        threading.Thread(target=self._probe_loop, daemon=True).start()
+        _log(f"peer visibility prober up on :{port} "
+             f"(roster {sorted(self.roster)})")
 
     # ---- persistence -------------------------------------------------------
 
@@ -197,9 +318,16 @@ class FakeEtcd:
         t = threading.Thread(target=self._srv.serve_forever,
                              daemon=True)
         t.start()
+        if len(self.roster) > 1 and args.listen_peer_urls:
+            self._start_peer_plane()
         _log(f"serving client requests on {args.listen_client_urls}")
         _log("ready to serve client requests")
         self._stopping.wait()
+        if self._peer_srv is not None:
+            try:
+                self._peer_srv.close()
+            except OSError:
+                pass
         self._srv.shutdown()
         self._srv.server_close()
         self.persist()

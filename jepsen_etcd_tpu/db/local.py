@@ -18,8 +18,13 @@ log dirs — and this driver owns the name -> client URL mapping
 (client_url), which the client factory consults in local mode.
 
 Fault support matrix (compose.py enforces it with specific refusals):
-kill / pause / member / admin work; partition and clock need a
-privileged netns/iptables layer this process-level plane does not have.
+kill / pause / member / admin work directly; partition and latency ride
+the userspace TCP proxy plane (net/plane.py, ``--net-proxy`` — auto-set
+when those faults are requested): every advertised client and peer URL
+points at a per-node ingress proxy while the process listens on its
+real port, so drop/latency rules apply to all inter-node and client
+traffic without netns/iptables privileges. Clock skew still needs
+per-process time virtualization and stays refused.
 """
 
 from __future__ import annotations
@@ -91,6 +96,14 @@ class LocalDb:
         self.initialized = False
         # node -> (client_port, peer_port); allocated lazily per node
         self.ports: dict[str, tuple[int, int]] = {}
+        # userspace network fault plane (--net-proxy): advertised URLs
+        # route through per-node ingress proxies; None = direct wiring
+        self.plane = None
+        # node -> (client_proxy_port, peer_proxy_port) when fronted
+        self.proxy_ports: dict[str, tuple[int, int]] = {}
+        if self.opts.get("net_proxy"):
+            from ..net.plane import NetPlane
+            self.plane = NetPlane(seed=int(self.opts.get("seed") or 0))
         # node -> live Popen (dead ones are reaped out on kill/start)
         self.procs: dict[str, subprocess.Popen] = {}
         # every Popen ever spawned, for teardown + leak accounting
@@ -115,12 +128,34 @@ class LocalDb:
     def _ensure_ports(self, node: str) -> None:
         if node not in self.ports:
             self.ports[node] = (free_port(), free_port())
+            if self.plane is not None:
+                client_port, peer_port = self.ports[node]
+                self.proxy_ports[node] = (
+                    self.plane.front(node, "client", client_port),
+                    self.plane.front(node, "peer", peer_port))
 
     def client_url(self, node: str) -> str:
+        """What clients (and other nodes' member APIs) dial: the
+        ingress proxy when the net plane is up, else the real port."""
         self._ensure_ports(node)
+        if self.plane is not None:
+            return f"http://127.0.0.1:{self.proxy_ports[node][0]}"
         return f"http://127.0.0.1:{self.ports[node][0]}"
 
     def peer_url(self, node: str) -> str:
+        """The ADVERTISED peer URL (what --initial-cluster carries, so
+        every peer dial crosses the target's ingress proxy)."""
+        self._ensure_ports(node)
+        if self.plane is not None:
+            return f"http://127.0.0.1:{self.proxy_ports[node][1]}"
+        return f"http://127.0.0.1:{self.ports[node][1]}"
+
+    def listen_client_url(self, node: str) -> str:
+        """The real port the process binds (proxied or not)."""
+        self._ensure_ports(node)
+        return f"http://127.0.0.1:{self.ports[node][0]}"
+
+    def listen_peer_url(self, node: str) -> str:
         self._ensure_ports(node)
         return f"http://127.0.0.1:{self.ports[node][1]}"
 
@@ -145,9 +180,9 @@ class LocalDb:
         argv = list(self.binary) + [
             "--name", node,
             "--data-dir", self.data_dir(node),
-            "--listen-client-urls", self.client_url(node),
+            "--listen-client-urls", self.listen_client_url(node),
             "--advertise-client-urls", self.client_url(node),
-            "--listen-peer-urls", self.peer_url(node),
+            "--listen-peer-urls", self.listen_peer_url(node),
             "--initial-advertise-peer-urls", self.peer_url(node),
             "--initial-cluster",
             ",".join(f"{n}={self.peer_url(n)}" for n in sorted(roster)),
@@ -252,8 +287,28 @@ class LocalDb:
             loop.spawn(self._await_node_ready(test, n, state="new"))
             for n in sorted(self.members)])
         self.initialized = True
+        if self.plane is not None:
+            await self._register_member_ids(test)
         logger.info("local cluster ready: %s (binary %s)",
                     sorted(self.members), self.binary[0])
+
+    async def _register_member_ids(self, test: dict) -> None:
+        """Teach the net plane real member-id -> name attribution: a
+        real etcd's rafthttp dials carry X-Server-From: <member-id-hex>
+        and the ids are only known once the cluster has formed."""
+        c = self._client(test, sorted(self.members)[0])
+        try:
+            mapping = {}
+            for m in await c.member_list():
+                if m.get("name") and m.get("id") is not None:
+                    mapping[f"{int(m['id']):x}"] = m["name"]
+            self.plane.register_member_ids(mapping)
+        except (SimError, TimeoutError):
+            # attribution degrades gracefully: unattributed peer links
+            # are never directionally dropped
+            logger.warning("member-id attribution unavailable")
+        finally:
+            c.close()
 
     async def teardown(self, test: dict) -> None:
         self.stop_all()
@@ -278,6 +333,8 @@ class LocalDb:
             h.close()
         self._log_handles.clear()
         self.procs.clear()
+        if self.plane is not None:
+            self.plane.close()
 
     def leaked_pids(self) -> list[int]:
         """Live children after teardown: tracked Popens still running,

@@ -57,6 +57,12 @@ DET_WALLCLOCK_ALLOW = (
                                   # to its reader/dispatcher threads)
     "db/local.py",
     "db/fake_etcd.py",
+    "net/*",            # userspace proxy plane: socket splice loops
+                        # sleep real seconds to inject latency and
+                        # bandwidth caps — transport I/O by design,
+                        # never verdict input (the checker only ever
+                        # sees the resulting history timestamps from
+                        # WallLoop)
     "sut/*",            # gateway bridges: readiness deadlines against
                         # live sockets/processes, never verdict input
     "client/etcdctl.py",

@@ -133,15 +133,44 @@ def _process_package(kind: str, opts: dict, targets: list[str]) -> dict:
     }
 
 
-# ---- partition -------------------------------------------------------------
+# ---- partition / latency ---------------------------------------------------
+
+def _partition_pool(test: dict) -> list[str]:
+    """Nodes a network fault can target: alive sim nodes, or the local
+    control plane's current membership."""
+    cluster = test.get("cluster")
+    if cluster is not None:
+        nodes = sorted(cluster.nodes)
+        alive = [n for n in nodes if cluster.nodes[n].alive]
+        return alive or nodes
+    db = test["db"]
+    return sorted(db.members or test["nodes"])
+
+
+def _net_backend(test: dict):
+    """The network fault surface: the simulated Cluster, or the local
+    control plane's userspace proxy fleet (net/plane.py). Both speak
+    partition/partition_pairs/heal_partition/set_latency/clear_latency
+    with the shared blocked-pair encoding."""
+    cluster = test.get("cluster")
+    if cluster is not None:
+        return cluster
+    plane = getattr(test.get("db"), "plane", None)
+    if plane is None:
+        raise SimError(
+            "unsupported",
+            "no network fault backend: need the sim cluster or "
+            "--db local with the net proxy plane (--net-proxy)",
+            definite=True)
+    return plane
+
 
 def _partition_groups(test: dict, spec: str, primaries: list) -> Any:
-    """Compute a partition for the cluster. Returns either a list of
-    groups (disjoint isolation) or a set of blocked pairs (ring)."""
+    """Compute a partition. Returns either a list of groups (disjoint
+    isolation) or a set of blocked pairs — frozensets are
+    bidirectional, ordered (src, dst) tuples are one-way."""
     rng = current_loop().rng
-    nodes = sorted(test["cluster"].nodes)
-    alive = [n for n in nodes if test["cluster"].nodes[n].alive]
-    pool = alive or nodes
+    pool = _partition_pool(test)
     if spec == "primaries" and primaries:
         p = rng.choice(sorted(primaries))
         return [[p], [n for n in pool if n != p]]
@@ -164,27 +193,44 @@ def _partition_groups(test: dict, spec: str, primaries: list) -> Any:
                 if dist > keep:
                     blocked.add(frozenset((sh[i], sh[j])))
         return blocked
+    if spec == "bridge":
+        # two halves that only communicate through one bridge node
+        # (jepsen.nemesis bridge): neither half has a majority alone,
+        # the bridge sees everyone
+        sh = list(pool)
+        rng.shuffle(sh)
+        bridge, rest = sh[0], sh[1:]
+        half = len(rest) // 2
+        g1, g2 = rest[:half], rest[half:]
+        return {frozenset((a, b)) for a in g1 for b in g2}
+    if spec == "one-way":
+        # asymmetric: one node's OUTBOUND traffic is blackholed while
+        # inbound still flows — the fault class a symmetric
+        # groups-based partition cannot express
+        x = rng.choice(list(pool))
+        return {(x, o) for o in pool if o != x}
     raise ValueError(f"unknown partition spec {spec!r}")
 
 
 def partition_package(opts: dict) -> dict:
     interval = int(opts.get("nemesis_interval", 5) * SECOND)
-    targets = ["primaries", "majority", "majorities-ring"]
+    targets = ["primaries", "majority", "majorities-ring", "bridge",
+               "one-way"]
 
     async def start(test, op):
         primaries = await test["db"].primaries(test)
         g = _partition_groups(test, op.value, primaries)
-        cluster = test["cluster"]
+        backend = _net_backend(test)
         if isinstance(g, set):
-            cluster.blocked_pairs = g
-            desc = "majorities-ring"
+            backend.partition_pairs(g)
+            desc = f"{op.value} ({len(g)} blocked links)"
         else:
-            cluster.partition(g)
+            backend.partition(g)
             desc = [sorted(x) for x in g]
         return op.evolve(type="info", value=desc)
 
     async def stop(test, op):
-        test["cluster"].heal_partition()
+        _net_backend(test).heal_partition()
         return op.evolve(type="info", value="fully-connected")
 
     def gen_start(test, ctx):
@@ -203,6 +249,43 @@ def partition_package(opts: dict) -> dict:
                   "fs": ["start-partition", "stop-partition"],
                   "start": ["start-partition"],
                   "stop": ["stop-partition"], "color": "#E9DCA0"}],
+    }
+
+
+def latency_package(opts: dict) -> dict:
+    """Injected link latency + jitter: the sim adds a bounded extra
+    delay to every message leg; local mode programs the proxy plane
+    (net/plane.py), which sleeps real milliseconds per chunk."""
+    interval = int(opts.get("nemesis_interval", 5) * SECOND)
+
+    async def start(test, op):
+        v = op.value or {}
+        _net_backend(test).set_latency(float(v.get("delta-ms", 50)),
+                                       float(v.get("jitter-ms", 0)))
+        return op.evolve(type="info")
+
+    async def stop(test, op):
+        _net_backend(test).clear_latency()
+        return op.evolve(type="info", value="latency-cleared")
+
+    def gen_start(test, ctx):
+        return {"f": "start-latency",
+                "value": {"delta-ms": 2 ** ctx.rng.randint(3, 7),
+                          "jitter-ms": 2 ** ctx.rng.randint(0, 5)}}
+
+    def gen_stop(test, ctx):
+        return {"f": "stop-latency", "value": None}
+
+    return {
+        "fs": {"start-latency", "stop-latency"},
+        "nemesis": _FnNemesis({"start-latency": start,
+                               "stop-latency": stop}),
+        "generator": stagger(interval, mix([gen_start, gen_stop])),
+        "final_generator": limit(1, fn_gen(gen_stop)),
+        "perf": [{"name": "latency",
+                  "fs": ["start-latency", "stop-latency"],
+                  "start": ["start-latency"],
+                  "stop": ["stop-latency"], "color": "#C9E9A0"}],
     }
 
 
@@ -433,6 +516,13 @@ def admin_package(opts: dict) -> dict:
 
 # ---- composition -----------------------------------------------------------
 
+#: every fault name the nemesis layer knows (compose.py's fault matrix
+#: and the CLI validate against this)
+KNOWN_FAULTS = frozenset({
+    "kill", "pause", "partition", "latency", "clock", "member", "admin",
+    "bitflip-wal", "bitflip-snap", "truncate-wal"})
+
+
 def build_packages(opts: dict, faults: set) -> dict:
     """Build and compose the packages for the requested fault set
     (nemesis-package, nemesis.clj:200-209)."""
@@ -445,6 +535,8 @@ def build_packages(opts: dict, faults: set) -> dict:
                                          ["primaries", "all"]))
     if "partition" in faults:
         packages.append(partition_package(opts))
+    if "latency" in faults:
+        packages.append(latency_package(opts))
     if "clock" in faults:
         packages.append(clock_package(opts))
     if "member" in faults:
@@ -454,9 +546,7 @@ def build_packages(opts: dict, faults: set) -> dict:
     cp = corrupt_package(opts, faults)
     if cp is not None:
         packages.append(cp)
-    known = ({"kill", "pause", "partition", "clock", "member", "admin",
-              "bitflip-wal", "bitflip-snap", "truncate-wal"})
-    unknown = faults - known
+    unknown = faults - KNOWN_FAULTS
     if unknown:
         raise ValueError(f"unknown faults {sorted(unknown)}")
     if not packages:

@@ -126,6 +126,13 @@ REGISTRY = {
         "independent.keys",       # per-key fanout of the independent
                                   # split (the producer side of the
                                   # batching axis)
+        "net.links",              # net/plane.py proxy fleet: proxies
+                                  # raised in front of node ports
+        "net.dropped_conns",      # connections blackholed by a drop
+                                  # rule or refused (node down)
+        "net.delayed_bytes",      # bytes that paid injected latency
+        "net.active_rules",       # peak concurrent fault rules
+                                  # (mode=max)
     ),
     "events": (
         "telemetry.dropped",

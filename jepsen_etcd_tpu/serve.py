@@ -493,6 +493,16 @@ def run_html(store_base: str, rel: str) -> str:
                            f"<code>{html.escape(str(k))}</code>={v}"
                            for k, v in sorted(counters.items()))
                        + "</p>")
+        if counters.get("net.links"):
+            # the userspace proxy plane ran: call out its fault totals
+            out.append("<p class='dim'>net proxy plane: "
+                       f"{counters.get('net.links', 0)} links fronted, "
+                       f"{counters.get('net.dropped_conns', 0)} conns "
+                       "dropped/blackholed, "
+                       f"{counters.get('net.delayed_bytes', 0)} bytes "
+                       "delayed, peak "
+                       f"{counters.get('net.active_rules', 0)} active "
+                       "rules</p>")
         if tel.get("dropped"):
             out.append(f"<p class='bad'>{tel['dropped']} telemetry "
                        "records dropped past the cap</p>")

@@ -326,8 +326,8 @@ class Watcher:
         self._outbox.append(evs)
         if not self._draining:
             self._draining = True
-            delay = self.node.loop.rng.randint(
-                *self.node.cluster.cfg.rpc_delay)
+            delay = self.node.cluster.msg_delay(
+                self.node.cluster.cfg.rpc_delay)
             self.node.loop.call_later(delay, self._drain)
 
     def _drain(self) -> None:
@@ -363,7 +363,14 @@ class Cluster:
         self.initial_names = list(node_names)
         self.nodes: dict[str, Node] = {
             n: Node(n, self, node_names) for n in node_names}
-        self.blocked_pairs: set[frozenset] = set()
+        # blocked link set: frozensets block both directions, ordered
+        # (src, dst) tuples block only src -> dst (one-way partitions —
+        # the same encoding net/plane.py uses in local mode)
+        self.blocked_pairs: set = set()
+        # (lo_ns, hi_ns) extra per-message-leg delay when a latency
+        # fault is active; None = no fault and NO extra rng draw, so
+        # fault-free seeded histories stay bit-identical
+        self.net_latency: Optional[tuple[int, int]] = None
         self.running = False
         self._tick_task = None
         self.next_lease_id = 0x70000000
@@ -404,6 +411,9 @@ class Cluster:
     # ---- connectivity -----------------------------------------------------
 
     def reachable(self, a: str, b: str) -> bool:
+        """Can a message leg travel a -> b right now? Callers pass the
+        actual direction per leg (request legs src->dst, response legs
+        dst->src), so one-way blocks drop exactly one side."""
         if a == b:
             return True
         na, nb = self.nodes.get(a), self.nodes.get(b)
@@ -411,7 +421,17 @@ class Cluster:
             return False
         if not (na.alive and nb.alive) or na.paused or nb.paused:
             return False
-        return frozenset((a, b)) not in self.blocked_pairs
+        return (frozenset((a, b)) not in self.blocked_pairs
+                and (a, b) not in self.blocked_pairs)
+
+    def msg_delay(self, base: tuple) -> int:
+        """One message-leg delay draw. The injected-latency draw
+        happens ONLY while a latency fault is active: the rng stream of
+        fault-free runs is untouched (same-seed bit-identity)."""
+        d = self.loop.rng.randint(*base)
+        if self.net_latency is not None:
+            d += self.loop.rng.randint(*self.net_latency)
+        return d
 
     def visible_majority(self, node: Node) -> bool:
         peers = [m for m in node.membership]
@@ -452,7 +472,7 @@ class Cluster:
                             last_index: int) -> None:
         # request leg: delivered only if both ends are up and connected
         # at arrival time (same drop model as _send_append)
-        await self.loop.sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+        await self.loop.sleep(self.msg_delay(self.cfg.repl_delay))
         peer = self.nodes.get(peer_name)
         if (peer is None or peer.removed
                 or not self.reachable(cand.name, peer_name)):
@@ -477,7 +497,7 @@ class Cluster:
                 granted = True
         resp_term = peer.term
         # response leg
-        await self.loop.sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+        await self.loop.sleep(self.msg_delay(self.cfg.repl_delay))
         delivered = self.reachable(peer_name, cand.name)
         self._trace("vote-resp", peer_name, cand.name, term=resp_term,
                     granted=granted, delivered=delivered)
@@ -555,7 +575,7 @@ class Cluster:
 
     async def _send_append(self, leader: Node, peer_name: str) -> None:
         try:
-            await self.loop.sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+            await self.loop.sleep(self.msg_delay(self.cfg.repl_delay))
         finally:
             # past the coalescing window: appends after this point need
             # (and will get) a fresh sender. Cleared in finally — a
@@ -765,7 +785,7 @@ class Cluster:
         n = self.nodes.get(node_name)
         if n is None:
             raise SimError("unavailable", f"unknown node {node_name}")
-        await self.loop.sleep(self.loop.rng.randint(*self.cfg.rpc_delay))
+        await self.loop.sleep(self.msg_delay(self.cfg.rpc_delay))
         if not n.alive:
             raise SimError("connect-failed", node_name)
         if n.removed:
@@ -787,7 +807,7 @@ class Cluster:
                 return node
             leader = self.current_leader_visible(node)
             if leader is not None:
-                await self.loop.sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+                await self.loop.sleep(self.msg_delay(self.cfg.repl_delay))
                 return leader
             await self.loop.sleep(self.cfg.heartbeat_interval)
             if not node.alive:
@@ -798,7 +818,7 @@ class Cluster:
         n = await self._enter(node_name)
         leader = await self._at_leader(n)
         result = await self.propose(leader.name, "txn", txn)
-        await self.loop.sleep(self.loop.rng.randint(*self.cfg.rpc_delay))
+        await self.loop.sleep(self.msg_delay(self.cfg.rpc_delay))
         return result
 
     async def kv_read(self, node_name: str, key: str,
@@ -811,7 +831,7 @@ class Cluster:
         leader = await self._at_leader(n)
         await self._read_index(leader)
         out = {"kv": leader.store.get(key), "revision": leader.store.revision}
-        await self.loop.sleep(self.loop.rng.randint(*self.cfg.rpc_delay))
+        await self.loop.sleep(self.msg_delay(self.cfg.rpc_delay))
         return out
 
     def _committed_own_term(self, leader: Node) -> bool:
@@ -844,7 +864,7 @@ class Cluster:
         entries its predecessor acked.
         """
         while True:
-            await self.loop.sleep(self.loop.rng.randint(*self.cfg.repl_delay))
+            await self.loop.sleep(self.msg_delay(self.cfg.repl_delay))
             if not leader.alive:
                 raise SimError("unavailable", leader.name)
             if leader.role != "leader":
@@ -962,7 +982,7 @@ class Cluster:
             w.next_rev = max(e.revision for e in backlog) + 1
             w._outbox.append(backlog)
             w._draining = True
-            delay = self.loop.rng.randint(*self.cfg.rpc_delay)
+            delay = self.msg_delay(self.cfg.rpc_delay)
             self.loop.call_later(delay, w._drain)
         return w
 
@@ -1162,8 +1182,23 @@ class Cluster:
                 if group_of.get(a) != group_of.get(b):
                     self.blocked_pairs.add(frozenset((a, b)))
 
+    def partition_pairs(self, pairs) -> None:
+        """Install an explicit blocked set: frozensets block both
+        directions, ordered (src, dst) tuples block only src -> dst
+        (asymmetric partitions; same encoding as net/plane.py)."""
+        self.blocked_pairs = set(pairs)
+
     def heal_partition(self) -> None:
         self.blocked_pairs = set()
+
+    def set_latency(self, delta_ms: float, jitter_ms: float = 0) -> None:
+        """Inject delta + U(0, jitter) extra delay on every message
+        leg (the sim backend of the latency nemesis package)."""
+        lo = int(delta_ms * MS)
+        self.net_latency = (lo, lo + int(jitter_ms * MS))
+
+    def clear_latency(self) -> None:
+        self.net_latency = None
 
     def bump_clock(self, name: str, delta_ns: int) -> None:
         self.nodes[name].clock_offset += delta_ns

@@ -79,10 +79,18 @@ class GatewayState:
             member_id: {"name": name,
                         "peerURLs": ["http://localhost:0"],
                         "clientURLs": []}}
+        # quorum surface: fake-etcd installs a callable reporting
+        # whether this node currently sees a roster majority (peer
+        # probes, db/fake_etcd.py). None = single-node / always-quorate.
+        self.quorum_check = None
 
     def leader_id(self) -> int:
         # deterministic single leader across every node's view: the
-        # lowest member id (fake nodes share no raft; min() agrees)
+        # lowest member id (fake nodes share no raft; min() agrees).
+        # A node cut off from the roster majority has no leader — the
+        # wire shape real etcd gives a partitioned minority.
+        if self.quorum_check is not None and not self.quorum_check():
+            return 0
         return min(self.members) if self.members else 0
 
     def member_wire(self, mid: int) -> dict:
@@ -100,6 +108,18 @@ class GatewayState:
             "mod_revision": str(kv["mod-revision"]),
             "lease": str(kv.get("lease", 0)),
         }
+
+
+#: paths that need a quorum (writes, linearizable machinery): a real
+#: etcd in a partitioned minority fails these with "no leader".
+#: Serializable ranges, status, watches, member/list, and lease
+#: keepalive stay served from local state, like real etcd.
+QUORUM_PATHS = frozenset({
+    "/v3/kv/txn", "/v3/kv/compaction",
+    "/v3/lease/grant", "/v3/lease/revoke",
+    "/v3/lock/lock", "/v3/lock/unlock",
+    "/v3/cluster/member/add", "/v3/cluster/member/remove",
+})
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -128,6 +148,16 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(400, 3, "invalid json")
         st = self.state
         path = self.path
+        # body may be any JSON value here (fuzzed frames send lists /
+        # null); non-dict bodies fail per-path validation below
+        needs_quorum = path in QUORUM_PATHS or (
+            path == "/v3/kv/range" and not (
+                isinstance(body, dict) and body.get("serializable")))
+        if needs_quorum and st.quorum_check is not None \
+                and not st.quorum_check():
+            # same grpc code (14, unavailable) + message real etcd
+            # emits, so client/etcd_http.py classifies identically
+            return self._error(503, 14, "etcdserver: no leader")
         try:
             if path == "/v3/kv/range":
                 # full Range semantics: optional range_end (half-open

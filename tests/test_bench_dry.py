@@ -49,6 +49,17 @@ def test_dry_streaming_cell():
     assert cell["ops"] > 0
 
 
+def test_dry_net_overhead_cell():
+    """Tier-1 guard: a no-fault proxied local run's verdict skeleton
+    is bit-identical to the direct run's (the proxy plane is invisible
+    to checkers)."""
+    res = run_dry("--cell", "net_overhead")
+    cell = res["dry"]["net_overhead"]
+    assert cell["ok"] is True and cell["check"] == "_dry_net_overhead"
+    assert cell["links"] == 2
+    assert cell["verdicts_identical"] is True
+
+
 def test_dry_campaign_cell():
     res = run_dry("--cell", "campaign_amortization")
     cell = res["dry"]["campaign_amortization"]

@@ -62,6 +62,35 @@ def test_cli_flags_reach_opts():
     assert d["snapshot_count"] == 100
     assert d["unsafe_no_fsync"] is False
     assert d["corrupt_check"] is False
+    assert d["net_proxy"] is False
+    p = opts_from_args(build_parser().parse_args(["test", "--net-proxy"]))
+    assert p["net_proxy"] is True
+
+
+# ---- fault / privilege matrix (README table) -------------------------------
+
+def test_fault_matrix_rows():
+    """The rows the README table and `--db local` refusals are built
+    from: partition + latency flipped to supported by the proxy plane
+    (PR 11); clock and corruption stay refused with specific reasons."""
+    from jepsen_etcd_tpu.compose import fault_matrix
+    from jepsen_etcd_tpu.nemesis.faults import KNOWN_FAULTS
+    local = fault_matrix("local")
+    assert set(local) == set(KNOWN_FAULTS)
+    assert local["partition"] == {"supported": True, "why": None}
+    assert local["latency"] == {"supported": True, "why": None}
+    for fault in ("kill", "pause", "member", "admin"):
+        assert local[fault]["supported"] is True, fault
+    assert local["clock"]["supported"] is False
+    assert "CAP_SYS_TIME" in local["clock"]["why"]
+    for fault in ("bitflip-wal", "bitflip-snap", "truncate-wal"):
+        assert local[fault]["supported"] is False, fault
+        assert "corruption" in local[fault]["why"], fault
+    sim = fault_matrix("sim")
+    assert all(row["supported"] for row in sim.values())
+    live = fault_matrix("live")
+    assert not any(row["supported"] for row in live.values())
+    assert all(row["why"] for row in live.values())
 
 
 # ---- corrupt-check monitor ------------------------------------------------
@@ -155,7 +184,7 @@ def test_test_all_default_matrix():
     wls, nems = _test_all_matrix(_args([]))
     assert wls == ALL_WORKLOADS          # :none excluded (etcd.clj:48-49)
     assert "none" not in wls
-    assert len(nems) == 8
+    assert len(nems) == 9
     # drift guard: the sweep list must track the registry
     from jepsen_etcd_tpu.workloads import workloads
     assert set(ALL_WORKLOADS) == set(workloads()) - {"none"}
@@ -163,7 +192,7 @@ def test_test_all_default_matrix():
 
 def test_test_all_workload_narrowing():
     wls, nems = _test_all_matrix(_args(["-w", "set"]))
-    assert wls == ["set"] and len(nems) == 8
+    assert wls == ["set"] and len(nems) == 9
 
 
 def test_test_all_nemesis_narrowing():

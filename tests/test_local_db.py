@@ -366,19 +366,31 @@ def test_cli_local_register_run_with_kill_nemesis(tmp_path):
 
 
 def test_compose_refuses_unsupported_local_faults(tmp_path):
-    """partition/clock/corruption are refused with specific reasons,
-    not attempted and half-broken (compose.py fault matrix)."""
+    """clock/corruption are refused with specific reasons, not
+    attempted and half-broken (compose.py fault matrix); partition and
+    latency — refused before PR 11 — now compose through the userspace
+    proxy plane, raising net_proxy automatically."""
     from jepsen_etcd_tpu.compose import etcd_test
     base = {"client_type": "http", "db_mode": "local",
             "nodes": ["n1"], "etcd_binary": "fake",
             "etcd_data_dir": str(tmp_path)}
-    with pytest.raises(ValueError, match="netns/iptables"):
-        etcd_test(dict(base, nemesis=["partition"]))
     with pytest.raises(ValueError, match="CAP_SYS_TIME"):
         etcd_test(dict(base, nemesis=["clock"]))
     with pytest.raises(ValueError, match="corruption"):
         etcd_test(dict(base, nemesis=["bitflip-wal"]))
+    # a mixed request names ONLY the remaining unsupported faults
+    with pytest.raises(ValueError) as ei:
+        etcd_test(dict(base, nemesis=["partition", "clock"]))
+    assert "clock" in str(ei.value)
+    assert "partition" not in str(ei.value).split("Supported")[0]
     # supported combos compose fine
     t = etcd_test(dict(base, nemesis=["kill", "pause", "member",
                                       "admin"]))
     assert t["db_mode"] == "local"
+    assert t["net_proxy"] is False
+    t["db"].stop_all()
+    # network faults compose and auto-raise the proxy plane
+    t = etcd_test(dict(base, nemesis=["partition", "latency"]))
+    assert t["net_proxy"] is True
+    assert t["db"].plane is not None
+    t["db"].stop_all()

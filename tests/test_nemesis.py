@@ -55,6 +55,85 @@ def test_register_under_partition(tmp_path):
     assert "start-partition" in nemesis_fs(out["history"])
 
 
+def test_register_under_latency(tmp_path):
+    """Injected link latency slows the sim's message legs but must
+    never break linearizability — and the extra delay draws rng ONLY
+    while the fault is active (fault-free histories stay
+    bit-identical; test_sim pins that)."""
+    out = run(tmp_path, workload="register", nemesis=["latency"])
+    assert out["results"]["workload"]["valid?"] is True, \
+        "latency must not break linearizability"
+    assert "start-latency" in nemesis_fs(out["history"])
+
+
+def test_sim_directed_partition_blocks_one_direction():
+    """Ordered (src, dst) pairs block exactly one direction in the sim;
+    frozensets block both (the shared encoding with net/plane.py)."""
+    from jepsen_etcd_tpu.runner.sim import (SimLoop, set_current_loop,
+                                            sleep, SECOND)
+    from jepsen_etcd_tpu.sut.cluster import Cluster, ClusterConfig
+    loop = SimLoop(seed=1)
+    set_current_loop(loop)
+    try:
+        cluster = Cluster(loop, ["n1", "n2", "n3"], ClusterConfig())
+        cluster.launch()  # reachable() is False for unlaunched nodes
+        loop.run_coro(sleep(SECOND // 1000))  # start launch coroutines
+        cluster.partition_pairs({("n1", "n2")})
+        assert cluster.reachable("n1", "n2") is False
+        assert cluster.reachable("n2", "n1") is True
+        assert cluster.reachable("n1", "n3") is True
+        cluster.partition_pairs({frozenset(("n1", "n2"))})
+        assert cluster.reachable("n1", "n2") is False
+        assert cluster.reachable("n2", "n1") is False
+        cluster.heal_partition()
+        assert cluster.reachable("n1", "n2") is True
+        # latency knob: extra delay only while the fault is active
+        base = (10, 20)
+        cluster.set_latency(50, jitter_ms=10)
+        assert cluster.net_latency is not None
+        # 50 ms of injected delay dominates the 10-20 tick base range
+        assert cluster.msg_delay(base) > base[1]
+        cluster.clear_latency()
+        assert cluster.net_latency is None
+        assert base[0] <= cluster.msg_delay(base) <= base[1]
+        cluster.shutdown()
+    finally:
+        set_current_loop(None)
+
+
+def test_partition_spec_shapes():
+    """The new partition specs produce the documented shapes: one-way
+    is a single source's outbound tuples, bridge splits the non-bridge
+    rest into two halves blocked pairwise."""
+    from jepsen_etcd_tpu.runner.sim import SimLoop, set_current_loop
+    from jepsen_etcd_tpu.sut.cluster import Cluster, ClusterConfig
+    from jepsen_etcd_tpu.nemesis.faults import _partition_groups
+    loop = SimLoop(seed=2)
+    set_current_loop(loop)
+    try:
+        nodes = ["n1", "n2", "n3", "n4", "n5"]
+        cluster = Cluster(loop, nodes, ClusterConfig())
+        test = {"cluster": cluster}
+        ow = _partition_groups(test, "one-way", [])
+        assert isinstance(ow, set) and len(ow) == 4
+        srcs = {p[0] for p in ow}
+        assert len(srcs) == 1
+        assert all(isinstance(p, tuple) and not isinstance(p, frozenset)
+                   for p in ow)
+        br = _partition_groups(test, "bridge", [])
+        assert isinstance(br, set) and br
+        assert all(isinstance(p, frozenset) for p in br)
+        # 5 nodes: bridge + halves of 2 -> 2x2 blocked cross pairs,
+        # and the bridge node appears in none of them
+        assert len(br) == 4
+        blocked_nodes = set().union(*br)
+        assert len(blocked_nodes) == 4
+        bridge = (set(nodes) - blocked_nodes).pop()
+        assert all(bridge not in pair for pair in br)
+    finally:
+        set_current_loop(None)
+
+
 def test_register_under_pause_clock(tmp_path):
     # longer window: enough nemesis cycles that both fault classes fire
     # regardless of where the seed lands the pause/clock mix

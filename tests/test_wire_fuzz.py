@@ -113,6 +113,38 @@ def test_http_gateway_survives_malformed_frames(gateway_port):
     assert "header" in json.loads(body)
 
 
+def test_http_gateway_fuzz_through_net_proxy(gateway_port):
+    """The same malformed-frame corpus routed THROUGH the userspace
+    proxy plane (net/), on both leg kinds: the client-kind leg must be
+    a transparent splice, and the peer-kind leg's attribution sniffer
+    must classify-or-pass garbage first bytes — never wedge a
+    connection or crash a pump thread."""
+    from jepsen_etcd_tpu.net.plane import NetPlane
+    plane = NetPlane(seed=SEED)
+    ports = [plane.front("n1", "client", gateway_port),
+             plane.front("n1", "peer", gateway_port)]
+    try:
+        rng = random.Random(SEED)
+        paths = ["/v3/kv/range", "/v3/kv/put", "/v3/kv/txn",
+                 "/v3/lease/grant", "/v3/maintenance/status"]
+        for frame in http_corpus(rng):
+            for port in ports:
+                status, body = _post_raw(port, rng.choice(paths), frame)
+                assert 200 <= status < 600, (port, frame[:40])
+                if status >= 400:
+                    err = json.loads(body)
+                    assert "code" in err and "message" in err
+        # both proxied legs still healthy for a well-formed request
+        for port in ports:
+            status, body = _post_raw(
+                port, "/v3/kv/range",
+                json.dumps({"key": _b64key()}).encode())
+            assert status == 200
+            assert "header" in json.loads(body)
+    finally:
+        plane.close()
+
+
 # ---- native-gRPC gateway ---------------------------------------------------
 
 def _varint(n: int) -> bytes:
