@@ -954,12 +954,17 @@ def bench_net_overhead():
     stats = p_test["db"].plane.stats()
     added = (p_ms - d_ms) if (p_ms is not None and d_ms is not None) \
         else None
-    note(f"net-overhead: direct {d_ms:.2f}ms/{d_n} ops, proxied "
-         f"{p_ms:.2f}ms/{p_n} ops (added {added:+.2f}ms); "
+    # a run with no ok ops yields None latencies — report the
+    # degenerate cell instead of crashing on the format spec
+    def fmt(v, spec=".2f"):
+        return format(v, spec) if v is not None else "n/a"
+    note(f"net-overhead: direct {fmt(d_ms)}ms/{d_n} ops, proxied "
+         f"{fmt(p_ms)}ms/{p_n} ops (added {fmt(added, '+.2f')}ms); "
          f"plane={stats}")
     return {"value": round(added, 3) if added is not None else None,
             "unit": "added_ms_per_op",
-            "direct_ms": round(d_ms, 3), "proxied_ms": round(p_ms, 3),
+            "direct_ms": round(d_ms, 3) if d_ms is not None else None,
+            "proxied_ms": round(p_ms, 3) if p_ms is not None else None,
             "direct_ok_ops": d_n, "proxied_ok_ops": p_n,
             "plane": stats, "verdicts_identical": True,
             # overhead cell: vs_baseline is direct/proxied throughput

@@ -145,13 +145,16 @@ class FakeEtcd:
         self._persist_lock = threading.Lock()
         self._stopping = threading.Event()
         self._srv = None
-        # peer visibility: name -> peer URL roster to probe, and the
-        # set of roster members this node can currently round-trip to
-        # (self included). Starts optimistic so a clean boot reports a
+        # peer visibility: visible/total member counts published by the
+        # prober (self included). The probe targets and majority size
+        # come from the LIVE member list each round (_live_peers), not
+        # this boot roster — roster only gates whether the peer plane
+        # starts at all. Starts optimistic so a clean boot reports a
         # leader before the first probe round completes.
         self.roster = dict(roster)
         self._peer_lock = threading.Lock()
-        self._visible = set(self.roster) or {args.name}
+        self._visible_count = max(len(self.roster), 1)
+        self._member_total = max(len(self.roster), 1)
         self._peer_srv: socket.socket = None
         if len(self.roster) > 1:
             self.state.quorum_check = self._has_quorum
@@ -160,8 +163,19 @@ class FakeEtcd:
 
     def _has_quorum(self) -> bool:
         with self._peer_lock:
-            visible = len(self._visible)
-        return visible >= len(self.roster) // 2 + 1
+            return self._visible_count >= self._member_total // 2 + 1
+
+    def _live_peers(self) -> tuple[list[str], int]:
+        """Peer URLs of every *other* live member plus the live member
+        count, from state.members — member add/remove faults move the
+        real majority mid-run, so quorum must never judge against the
+        boot-time --initial-cluster roster."""
+        with self.state.lock:
+            urls = [m["peerURLs"][0]
+                    for mid, m in self.state.members.items()
+                    if mid != self.member_id and m.get("peerURLs")]
+            total = len(self.state.members)
+        return urls, total
 
     def _peer_answer(self, conn: socket.socket) -> None:
         """Answer one probe: read the preamble, echo our name back.
@@ -214,18 +228,21 @@ class FakeEtcd:
             return False
 
     def _probe_loop(self) -> None:
-        """Round-trip the preamble to every roster peer URL (under
-        --net-proxy these route through each target's ingress proxy,
-        where drop rules apply) and publish the visible set."""
+        """Round-trip the preamble to every live member's peer URL
+        (under --net-proxy these route through each target's ingress
+        proxy, where drop rules apply) and publish visible/total
+        counts. An added-but-unstarted member counts toward the
+        majority size but never answers — the same fault-tolerance
+        dent a real etcd takes from an unstarted learner."""
         while not self._stopping.wait(PROBE_INTERVAL_S):
-            seen = {self.args.name}
-            for name in sorted(self.roster):
-                if name == self.args.name:
-                    continue
-                if self._probe_one(self.roster[name]):
-                    seen.add(name)
+            urls, total = self._live_peers()
+            seen = 1  # self
+            for url in sorted(urls):
+                if self._probe_one(url):
+                    seen += 1
             with self._peer_lock:
-                self._visible = seen
+                self._visible_count = seen
+                self._member_total = max(total, 1)
 
     def _start_peer_plane(self) -> None:
         port = _url_port(self.args.listen_peer_urls)

@@ -20,7 +20,8 @@ semantics).
 Telemetry: ``net.links`` (proxies raised), ``net.dropped_conns``
 (connections blackholed or refused), ``net.delayed_bytes`` (bytes
 that paid injected latency), ``net.active_rules`` (peak concurrent
-fault rules) — all in the runner/telemetry.py REGISTRY.
+fault rules), ``net.accept_errors`` (transient accept() failures
+survived) — all in the runner/telemetry.py REGISTRY.
 
 The jitter RNG is a plane-owned seeded ``random.Random`` (DET002:
 no unseeded randomness, even off the verdict path).
@@ -186,6 +187,8 @@ class NetPlane:
             telemetry.current().counter("net.dropped_conns", value)
         elif event == "delayed":
             telemetry.current().counter("net.delayed_bytes", value)
+        elif event == "accept_error":
+            telemetry.current().counter("net.accept_errors", value)
 
     def stats(self) -> dict:
         with self._lock:

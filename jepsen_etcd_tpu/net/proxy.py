@@ -114,7 +114,11 @@ class LinkProxy:
         self.on_event = on_event or (lambda event, value: None)
         self._lock = threading.Lock()
         self._closed = False
-        self._conns: list[tuple] = []
+        #: live connections: [dsock, usock, legs_remaining] — both
+        #: sockets are closed and the entry pruned once both pump legs
+        #: have drained (the fake-etcd prober opens fresh connections
+        #: every 0.25s, so anything short of eager cleanup leaks fds)
+        self._conns: list[list] = []
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((listen_host, 0))
@@ -132,7 +136,15 @@ class LinkProxy:
             try:
                 dsock, _ = self._lsock.accept()
             except OSError:
-                return  # listener closed
+                with self._lock:
+                    if self._closed:
+                        return  # listener closed by close()
+                # transient accept failure (EMFILE, ECONNABORTED, ...):
+                # dying here would be a permanent unhealable partition,
+                # so surface it and keep serving
+                self.on_event("accept_error", 1)
+                time.sleep(0.05)
+                continue
             t = threading.Thread(target=self._handle, args=(dsock,),
                                  daemon=True,
                                  name=f"net-conn-{self.node}-{self.kind}")
@@ -152,18 +164,44 @@ class LinkProxy:
             self.on_event("dropped", 1)
             _close(dsock)
             return
+        entry = [dsock, usock, 2]  # two pump legs outstanding
         with self._lock:
             if self._closed:
                 _close(dsock)
                 _close(usock)
                 return
-            self._conns.append((dsock, usock))
+            self._conns.append(entry)
         down = threading.Thread(
-            target=self._pump, args=(usock, dsock, self.node, src),
+            target=self._run_pump,
+            args=(entry, usock, dsock, self.node, src),
             daemon=True, name=f"net-pump-{self.node}-{self.kind}")
         down.start()
         # upstream leg runs on this connection thread
-        self._pump(dsock, usock, src, self.node, initial)
+        self._run_pump(entry, dsock, usock, src, self.node, initial)
+
+    def _run_pump(self, entry: list, rsock: socket.socket,
+                  wsock: socket.socket, src: Optional[str], dst: str,
+                  initial: bytes = b"") -> None:
+        try:
+            self._pump(rsock, wsock, src, dst, initial)
+        finally:
+            self._leg_done(entry)
+
+    def _leg_done(self, entry: list) -> None:
+        """One pump leg finished; when both have, close both sockets
+        and forget the connection (clean-EOF legs only half-close in
+        _pump, so without this every finished connection leaks fds)."""
+        with self._lock:
+            entry[2] -= 1
+            done = entry[2] <= 0
+            if done:
+                try:
+                    self._conns.remove(entry)
+                except ValueError:
+                    pass  # already pruned by close()
+        if done:
+            _close(entry[0])
+            _close(entry[1])
 
     # ---- attribution sniffing ----------------------------------------------
 
@@ -270,6 +308,6 @@ class LinkProxy:
             conns = list(self._conns)
             self._conns.clear()
         _close(self._lsock)
-        for dsock, usock in conns:
+        for dsock, usock, _legs in conns:
             _close(dsock)
             _close(usock)

@@ -133,6 +133,8 @@ REGISTRY = {
         "net.delayed_bytes",      # bytes that paid injected latency
         "net.active_rules",       # peak concurrent fault rules
                                   # (mode=max)
+        "net.accept_errors",      # transient accept() failures the
+                                  # proxy survived (EMFILE, ...)
     ),
     "events": (
         "telemetry.dropped",
