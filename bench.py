@@ -750,12 +750,39 @@ def bench_gen_throughput():
     top = sorted(breakdown.items(), key=lambda kv: -kv[1]["s"])[:3]
     note("gen-throughput profile: " + " ".join(
         f"{k}={v['us_per_op']}us/op" for k, v in top))
+    # batched leg (ISSUE 13): the SAME register generation shape, but
+    # 16 seeds in one lockstep columnar pass (simbatch/, epoch-v2).
+    # Headline is AGGREGATE events/s across the batch — per-seed cost
+    # amortizes over the seed axis, which is the escape from the
+    # single-stream ~8-9k wall PR 6 hit (PERF.md §gen batched).
+    from jepsen_etcd_tpu.simbatch import generate_for_opts
+    bopts = {"workload": "register", "nodes": ["n1", "n2", "n3"],
+             "concurrency": 16, "rate": 1000.0, "time_limit": 7.52}
+    seeds = list(range(16))
+    generate_for_opts(bopts, seeds)  # warm numpy/import costs
+    bt0 = time.time()
+    bgen = generate_for_opts(bopts, seeds)
+    b_s = time.time() - bt0
+    b_rate = bgen["events"] / max(b_s, 1e-9)
+    note(f"gen-throughput batched: {bgen['events']} events across "
+         f"{len(seeds)} seeds in {b_s:.2f}s ({b_rate:,.0f} aggregate "
+         f"events/s, {bgen['epoch']})")
     return {"value": round(rate, 1), "unit": "events/s",
             "gen_s": round(gen_s, 2), "events": total,
             "per_op_us": round(1e6 * gen_s / max(total, 1), 2),
             "profiled": {"events": prof_total,
                          "wall_s": round(prof_s, 2),
                          "breakdown": breakdown},
+            "batched": {"value": round(b_rate, 1),
+                        "unit": "aggregate events/s",
+                        "seeds": len(seeds), "events": bgen["events"],
+                        "steps": bgen["steps"],
+                        "gen_s": round(b_s, 3),
+                        "epoch": bgen["epoch"],
+                        "per_seed_ops_per_s": round(
+                            b_rate / len(seeds), 1),
+                        "vs_single_stream": round(
+                            b_rate / max(rate, 1e-9), 2)},
             "vs_baseline": round(rate / SEED_GEN_OPS_PER_S, 2)}
 
 
@@ -1126,7 +1153,36 @@ def _dry_gen_throughput():
     assert set(bk) == {"timer_churn", "queue_hops", "generator_poll",
                        "record", "sut", "other"}, bk
     assert bk["generator_poll"]["s"] > 0 and bk["sut"]["s"] > 0, bk
-    return {"ops": len(h), "events": len(cols)}
+    batched = _dry_gen_batched()
+    return {"ops": len(h), "events": len(cols), "batched": batched}
+
+
+def _dry_gen_batched():
+    """Structural check of the batched leg (no timing asserts): a tiny
+    16-seed batch generates deterministically, histories are BORN
+    columnar (never materialized to dicts by generation itself), and
+    the genbatch stats the leg reports are self-consistent."""
+    from jepsen_etcd_tpu.simbatch import (GEN_EPOCH_V2,
+                                          generate_for_opts,
+                                          history_sha)
+    bopts = {"workload": "register", "nodes": ["n1", "n2", "n3"],
+             "concurrency": 8, "rate": 200.0, "time_limit": 2.0,
+             "seed": _DRY_SEED}
+    seeds = list(range(16))
+    g1 = generate_for_opts(bopts, seeds)
+    assert g1["epoch"] == GEN_EPOCH_V2, g1["epoch"]
+    assert len(g1["histories"]) == 16
+    assert g1["events"] == sum(len(h) for h in g1["histories"])
+    assert g1["steps"] > 0
+    for h in g1["histories"]:
+        assert h._ops is None, "batched history materialized dicts"
+        assert len(h.columns) == len(h)
+    g2 = generate_for_opts(bopts, seeds)
+    sh1 = [history_sha(h) for h in g1["histories"]]
+    sh2 = [history_sha(h) for h in g2["histories"]]
+    assert sh1 == sh2, "batched generation not deterministic"
+    return {"seeds": len(seeds), "events": g1["events"],
+            "steps": g1["steps"]}
 
 
 def _dry_watch():

@@ -3,6 +3,7 @@ from .core import (Checker, Compose, compose, Stats, UnhandledExceptions,
 from .independent import Independent, independent_checker
 from .linearizable import LinearizableChecker, linearizable, check_history
 from .perf import Perf
+from .session import SessionGuarantees, session_guarantees
 from .set_full import SetFull, set_full
 from .timeline import TimelineHtml
 
@@ -10,5 +11,6 @@ __all__ = [
     "Checker", "Compose", "compose", "Stats", "UnhandledExceptions",
     "LogFilePattern", "ClockPlot", "Noop", "Independent",
     "independent_checker", "LinearizableChecker", "linearizable",
-    "check_history", "Perf", "SetFull", "set_full", "TimelineHtml",
+    "check_history", "Perf", "SessionGuarantees", "session_guarantees",
+    "SetFull", "set_full", "TimelineHtml",
 ]

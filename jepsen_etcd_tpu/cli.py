@@ -128,6 +128,14 @@ def build_parser() -> argparse.ArgumentParser:
         s.add_argument("--soak-window-s", type=float, default=None,
                        help="per-window time limit in seconds "
                             "(default: --time-limit)")
+        s.add_argument("--soak-net-fault", action="append", default=None,
+                       metavar="KIND[:ARG]",
+                       help="long-lived net-plane fault schedule "
+                            "(--db local): windows cycle through "
+                            "[healthy] + these faults, each applied to "
+                            "the proxy plane for the WHOLE window and "
+                            "healed after. Kinds: latency[:delta-ms], "
+                            "drop[:probability], partition. Repeatable")
         s.add_argument("--test-count", type=int, default=1)
         s.add_argument("--only-workloads-expected-to-pass",
                        action="store_true")
@@ -156,6 +164,19 @@ def build_parser() -> argparse.ArgumentParser:
     camp.add_argument("--campaign-name", default="campaign",
                       help="store dir name for the campaign summary "
                            "(store/<name>/<id>/campaign.json)")
+    camp.add_argument("--gen-epoch", default="epoch-v1",
+                      choices=["epoch-v1", "epoch-v2"],
+                      help="generator epoch (epoch ledger, runner/"
+                           "sim.py): epoch-v2 routes every sim run "
+                           "through the batched lockstep generator "
+                           "(simbatch/) — S seeds per (workload, "
+                           "nemesis) cell generated in one columnar "
+                           "pass, histories born as OpColumns; runs "
+                           "the batched generator cannot serve (live "
+                           "clusters, unsupported workloads, --stream/"
+                           "--soak) fall back to epoch-v1, and every "
+                           "campaign.json row records the epoch that "
+                           "actually produced it")
     camp.add_argument("--force-kernel", action="store_true",
                       help="disable the native-DFS size cutoff so "
                            "every key is device-bound (coalescing "
@@ -250,6 +271,7 @@ def opts_from_args(args) -> dict:
         "soak": getattr(args, "soak", False),
         "soak_windows": getattr(args, "soak_windows", 0),
         "soak_window_s": getattr(args, "soak_window_s", None),
+        "soak_net_faults": getattr(args, "soak_net_fault", None) or [],
         "store_base": args.store,
     }
 
@@ -336,6 +358,7 @@ def main(argv=None) -> int:
         base = opts_from_args(args)
         if args.force_kernel:
             base["force_kernel"] = True
+        base["gen_epoch"] = args.gen_epoch
         wls, nemeses = test_all_matrix(args)
         specs = campaign_specs(base, wls, nemeses,
                                runs_per_cell=args.test_count,

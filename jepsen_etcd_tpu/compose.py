@@ -63,6 +63,10 @@ def default_opts() -> dict:
         "soak_windows": 0,              # 0 = run until interrupted
         "soak_window_s": None,          # per-window time limit (None:
                                         # --time-limit)
+        "soak_net_faults": [],          # --soak-net-fault schedule:
+                                        # windows cycle [healthy]+these,
+                                        # each held for a whole window
+                                        # on the proxy plane
         "version": "sim-3.5.6",         # etcd.clj:206-207 (pinned: the sim
                                         # has exactly one "binary")
         "checker_service": None,        # AF_UNIX socket of a campaign
@@ -82,6 +86,12 @@ def default_opts() -> dict:
                                         # proxy plane (net/plane.py).
                                         # Auto-set when partition or
                                         # latency faults are requested.
+        "gen_epoch": "epoch-v1",        # generator epoch (see the epoch
+                                        # ledger in runner/sim.py):
+                                        # epoch-v1 = SimLoop event loop;
+                                        # epoch-v2 routes campaign sim
+                                        # runs through the batched
+                                        # lockstep generator (simbatch/)
     }
 
 

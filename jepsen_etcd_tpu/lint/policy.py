@@ -33,6 +33,11 @@ COLUMNAR = (
     "checkers/perf.py",
     "checkers/timeline.py",
     "checkers/tpu_linearizable.py",
+    "checkers/session.py",
+    "simbatch/*",       # the batched generator BIRTHS histories as
+                        # columns; materializing dicts inside it would
+                        # defeat the subsystem (history_sha's to_jsonl
+                        # is the declared test/bench-only exception)
 )
 
 #: modules allowed to read the wall clock: the wall-time bridge itself,

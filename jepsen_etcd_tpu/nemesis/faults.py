@@ -260,18 +260,31 @@ def latency_package(opts: dict) -> dict:
 
     async def start(test, op):
         v = op.value or {}
-        _net_backend(test).set_latency(float(v.get("delta-ms", 50)),
-                                       float(v.get("jitter-ms", 0)))
+        backend = _net_backend(test)
+        backend.set_latency(float(v.get("delta-ms", 50)),
+                            float(v.get("jitter-ms", 0)))
+        # lossy-link rider: per-chunk drop probability, only the proxy
+        # plane speaks it (the sim cluster models loss as timeouts) —
+        # guard so the same spec works against either backend
+        set_dp = getattr(backend, "set_drop_prob", None)
+        if set_dp is not None and v.get("drop-prob"):
+            set_dp(float(v["drop-prob"]))
         return op.evolve(type="info")
 
     async def stop(test, op):
-        _net_backend(test).clear_latency()
+        backend = _net_backend(test)
+        backend.clear_latency()
+        clear_dp = getattr(backend, "clear_drop_prob", None)
+        if clear_dp is not None:
+            clear_dp()
         return op.evolve(type="info", value="latency-cleared")
 
     def gen_start(test, ctx):
         return {"f": "start-latency",
                 "value": {"delta-ms": 2 ** ctx.rng.randint(3, 7),
-                          "jitter-ms": 2 ** ctx.rng.randint(0, 5)}}
+                          "jitter-ms": 2 ** ctx.rng.randint(0, 5),
+                          "drop-prob": ctx.rng.choice(
+                              [0.0, 0.0, 0.01, 0.05])}}
 
     def gen_stop(test, ctx):
         return {"f": "stop-latency", "value": None}

@@ -18,10 +18,11 @@ latency/bandwidth/slow-close apply to every leg (tc-on-the-interface
 semantics).
 
 Telemetry: ``net.links`` (proxies raised), ``net.dropped_conns``
-(connections blackholed or refused), ``net.delayed_bytes`` (bytes
-that paid injected latency), ``net.active_rules`` (peak concurrent
-fault rules), ``net.accept_errors`` (transient accept() failures
-survived) — all in the runner/telemetry.py REGISTRY.
+(connections blackholed or refused), ``net.dropped_chunks`` (chunks
+lost to probabilistic drop), ``net.delayed_bytes`` (bytes that paid
+injected latency), ``net.active_rules`` (peak concurrent fault
+rules), ``net.accept_errors`` (transient accept() failures survived)
+— all in the runner/telemetry.py REGISTRY.
 
 The jitter RNG is a plane-owned seeded ``random.Random`` (DET002:
 no unseeded randomness, even off the verdict path).
@@ -53,6 +54,8 @@ class NetPlane:
         self.latency: Optional[tuple[float, float]] = None
         self.bandwidth_bps: float = 0.0
         self.slow_close_s: float = 0.0
+        #: per-chunk loss probability when a lossy-link fault is active
+        self.drop_prob: float = 0.0
         #: real-etcd member-id (hex string) -> node name, registered by
         #: db/local.py once the cluster has formed and ids are known
         self.member_names: dict[str, str] = {}
@@ -100,9 +103,10 @@ class NetPlane:
             lat = self.latency
             bw = self.bandwidth_bps
             sc = self.slow_close_s
-        if not (drop or lat or bw or sc):
+            dp = self.drop_prob
+        if not (drop or lat or bw or sc or dp):
             return PASS
-        return Rule(drop=drop,
+        return Rule(drop=drop, drop_prob=dp,
                     latency_s=lat[0] if lat else 0.0,
                     jitter_s=lat[1] if lat else 0.0,
                     bandwidth_bps=bw, slow_close_s=sc)
@@ -158,6 +162,19 @@ class NetPlane:
             self.slow_close_s = float(seconds)
         self._note_rules()
 
+    def set_drop_prob(self, p: float) -> None:
+        """Lossy-link fault: every chunk on every leg is independently
+        discarded with probability ``p`` (clamped to [0, 1]), drawn
+        from the plane's seeded RNG."""
+        with self._lock:
+            self.drop_prob = min(1.0, max(0.0, float(p)))
+        self._note_rules()
+
+    def clear_drop_prob(self) -> None:
+        with self._lock:
+            self.drop_prob = 0.0
+        self._note_rules()
+
     def heal(self) -> None:
         """Drop every active rule (partitions, latency, caps)."""
         with self._lock:
@@ -165,6 +182,7 @@ class NetPlane:
             self.latency = None
             self.bandwidth_bps = 0.0
             self.slow_close_s = 0.0
+            self.drop_prob = 0.0
         self._note_rules()
 
     # ---- accounting --------------------------------------------------------
@@ -174,7 +192,8 @@ class NetPlane:
         with self._lock:
             return (len(self.blocked) + (1 if self.latency else 0)
                     + (1 if self.bandwidth_bps else 0)
-                    + (1 if self.slow_close_s else 0))
+                    + (1 if self.slow_close_s else 0)
+                    + (1 if self.drop_prob else 0))
 
     def _note_rules(self) -> None:
         telemetry.current().counter("net.active_rules",
@@ -185,6 +204,8 @@ class NetPlane:
         dashboards join by name, graftlint TEL002 checks them)."""
         if event == "dropped":
             telemetry.current().counter("net.dropped_conns", value)
+        elif event == "chunk_dropped":
+            telemetry.current().counter("net.dropped_chunks", value)
         elif event == "delayed":
             telemetry.current().counter("net.delayed_bytes", value)
         elif event == "accept_error":
@@ -199,6 +220,7 @@ class NetPlane:
                 "latency": self.latency,
                 "bandwidth_bps": self.bandwidth_bps,
                 "slow_close_s": self.slow_close_s,
+                "drop_prob": self.drop_prob,
             }
 
     # ---- lifecycle ---------------------------------------------------------

@@ -15,6 +15,11 @@ ruled legs — upstream ``src -> node`` and downstream ``node -> src``):
                  connection stays "up" (connects succeed, requests
                  hang until the client times out — iptables DROP, not
                  REJECT);
+- ``drop_prob``  lossy link: each chunk is independently discarded
+                 with this probability (netem-loss analog). Decisions
+                 draw from the plane's seeded RNG via ``jitter()``, so
+                 a given seed yields a reproducible drop pattern for a
+                 given chunk sequence;
 - ``latency_s``  + ``jitter_s``: each chunk sleeps ``latency +
                  U(0, jitter)`` before forwarding. One pump thread per
                  direction, so delivery stays FIFO under jitter;
@@ -69,6 +74,7 @@ class Rule:
     """The fault policy for one link direction at one instant."""
 
     drop: bool = False
+    drop_prob: float = 0.0
     latency_s: float = 0.0
     jitter_s: float = 0.0
     bandwidth_bps: float = 0.0
@@ -266,6 +272,12 @@ class LinkProxy:
                 state["dropped"] = True
                 self.on_event("dropped", 1)
             return  # blackhole: discard, keep reading
+        if rule.drop_prob > 0 and self.jitter() < rule.drop_prob:
+            # lossy link: this chunk vanishes but the connection stays
+            # up — TCP-level loss seen by the application as a stall or
+            # a torn stream, not a closed socket
+            self.on_event("chunk_dropped", len(data))
+            return
         delay = rule.latency_s
         if rule.jitter_s:
             delay += rule.jitter_s * self.jitter()

@@ -62,7 +62,11 @@ def _pool_run(spec: dict) -> dict:
     — never the history — so result transfer stays cheap."""
     opts = dict(spec["opts"])
     row: dict = {"index": spec["index"], "workload": opts.get("workload"),
-                 "nemesis": opts.get("nemesis"), "seed": opts.get("seed")}
+                 "nemesis": opts.get("nemesis"), "seed": opts.get("seed"),
+                 # histories from a live cluster are observed, not
+                 # generated — no generator epoch applies there
+                 "gen-epoch": (None if opts.get("client_type")
+                               in ("http", "grpc") else "epoch-v1")}
     try:
         from ..compose import etcd_test
         from .test_runner import run_test
@@ -91,6 +95,109 @@ def _pool_run(spec: dict) -> dict:
                  if k.startswith("engine.")},
     )
     return row
+
+
+def _batchable(opts: dict) -> bool:
+    """True when the batched lockstep generator (simbatch/) can serve
+    this spec: an epoch-v2 sim run of a supported workload. Live
+    clusters produce observed histories (no generator epoch), and
+    --stream/--soak runs interleave generation with the run itself, so
+    all of those fall back to the epoch-v1 event loop."""
+    if opts.get("gen_epoch") != "epoch-v2":
+        return False
+    if opts.get("client_type") in ("http", "grpc"):
+        return False
+    if opts.get("db_mode") not in (None, "sim"):
+        return False
+    if opts.get("soak") or opts.get("stream"):
+        return False
+    from ..simbatch import supports
+    return supports(opts.get("workload", "register"))
+
+
+def _run_batched_cell(cell_specs: list, tel: Telemetry,
+                      genbatch: dict) -> list:
+    """One batched-generator cell: every spec in ``cell_specs`` shares
+    a (workload, nemesis) point of the matrix, so their seeds generate
+    in ONE lockstep columnar pass; each history then gets the normal
+    per-run epilogue (checker, store dir, artifacts) in this process.
+    Returns one summary row per spec, same shape as ``_pool_run``."""
+    import time as wall_time
+
+    from ..compose import etcd_test
+    from ..simbatch import generate_for_opts
+    from . import telemetry
+    from .store import make_store_dir
+    from .test_runner import _analyze_and_save, _make_telemetry
+
+    seeds = [int(s["opts"].get("seed", 0)) for s in cell_specs]
+    g0 = wall_time.time()
+    gen = generate_for_opts(dict(cell_specs[0]["opts"]), seeds)
+    gen_wall = wall_time.time() - g0
+    agg = round(gen["events"] / max(gen_wall, 1e-9), 1)
+    tel.counter("genbatch.cells")
+    tel.counter("genbatch.seeds", len(seeds))
+    tel.counter("genbatch.steps", gen["steps"])
+    tel.counter("genbatch.events", gen["events"])
+    tel.counter("genbatch.compactions", gen["compactions"])
+    tel.counter("genbatch.ops_per_s", agg, mode="max")
+    genbatch["cells"] += 1
+    genbatch["seeds"] += len(seeds)
+    genbatch["events"] += gen["events"]
+    genbatch["ops_per_s"] = max(genbatch["ops_per_s"], agg)
+    genbatch["epoch"] = gen["epoch"]
+    rows = []
+    for spec, history in zip(cell_specs, gen["histories"]):
+        opts = dict(spec["opts"])
+        row: dict = {"index": spec["index"],
+                     "workload": opts.get("workload"),
+                     "nemesis": opts.get("nemesis"),
+                     "seed": opts.get("seed"),
+                     "gen-epoch": gen["epoch"]}
+        t0 = wall_time.time()
+        run_tel = None
+        try:
+            test = etcd_test(opts)
+            test["cluster"] = None
+            store_dir = make_store_dir(opts.get("store_base", "store"),
+                                       test.get("name", "test"))
+            test["store_dir"] = store_dir
+            run_tel = _make_telemetry(test, store_dir)
+            cols = history.columns
+            sim_seconds = (float(cols.time[-1]) / 1e9 if len(cols)
+                           else 0.0)
+            out = _analyze_and_save(test, history, store_dir,
+                                    cluster=None, task_leak=None,
+                                    sim_seconds=sim_seconds, t0=t0,
+                                    node_logs={})
+        except Exception as e:  # a crashed run must not kill the cell
+            logger.exception("batched campaign run %s failed",
+                             spec["index"])
+            row.update(status="error", error=repr(e))
+            rows.append(row)
+            continue
+        finally:
+            telemetry.set_current(None)
+            if run_tel is not None:
+                run_tel.close()
+        tel_sum = (out.get("results") or {}).get("telemetry") or {}
+        counters = tel_sum.get("counters") or {}
+        phases = tel_sum.get("phases") or {}
+        row.update(
+            status="done", valid=out["valid?"], dir=out["dir"],
+            ops=len(out["history"]),
+            wall_s=round(out["wall-seconds"], 3),
+            gen_ops_per_s=agg,
+            check_s=round(phases.get("check", 0.0), 4),
+            dispatches=int(counters.get("wgl.dispatches", 0)
+                           + counters.get("mxu.dispatches", 0)),
+            service_fallbacks=int(counters.get("service.fallback", 0)),
+            service_shipped=int(counters.get("service.shipped", 0)),
+            engines={k[len("engine."):]: v for k, v in counters.items()
+                     if k.startswith("engine.")},
+        )
+        rows.append(row)
+    return rows
 
 
 def _expected_pass(workload: str) -> bool:
@@ -155,8 +262,33 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
             s["opts"] = opts
             run_specs.append(s)
         tel.counter("campaign.runs", len(run_specs))
+        # epoch-v2 specs the batched generator can serve leave the pool
+        # entirely: grouped by (workload, nemesis) cell, each cell's
+        # seeds generate in one lockstep columnar pass in THIS process,
+        # then check/save per run. Everything else (live, unsupported
+        # workload, stream/soak, epoch-v1) takes the pool as before.
+        cells: dict = {}
+        pooled = []
+        for s in run_specs:
+            if _batchable(s["opts"]):
+                key = (s["opts"].get("workload"),
+                       tuple(s["opts"].get("nemesis") or ()))
+                cells.setdefault(key, []).append(s)
+            else:
+                pooled.append(s)
+        genbatch = {"cells": 0, "seeds": 0, "events": 0,
+                    "ops_per_s": 0.0, "epoch": None}
         with tel.span("campaign.sweep", runs=len(run_specs),
                       pool=pool, service=bool(svc)):
+            for cell_specs in cells.values():
+                for row in _run_batched_cell(cell_specs, tel, genbatch):
+                    rows[row["index"]] = row
+                    fail = _tally_row(tel, row)
+                    if fail is not None:
+                        failures.append(fail)
+                    if on_row is not None:
+                        on_row(row)
+            run_specs = pooled
             if pool and pool > 0:
                 import concurrent.futures as cf
                 import multiprocessing as mp
@@ -200,6 +332,7 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
         "pool": pool,
         "valid?": not failures,
         "failures": failures,
+        "genbatch": genbatch if genbatch["cells"] else None,
         "runs": rows,
         "wall_s": round(time.monotonic() - t0, 3),
         "service": None if service_stats is None else {

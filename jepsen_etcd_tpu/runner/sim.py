@@ -14,6 +14,31 @@ This is intentionally *not* asyncio: the scheduler must be deterministic
 precise control of cancellation for op timeouts (cf. reference
 ``client.clj:244-252`` — await with 5 s timeout -> indefinite result).
 
+Generator-epoch ledger
+----------------------
+The same-instant ordering rule IS the determinism contract: the golden
+hashes pin histories, and the hashes are only stable because the rule
+below never changes silently. Changing how ties break — or anything
+else that re-keys a same-seed history — requires declaring a NEW epoch
+here, not editing an old one.
+
+- **epoch-v1** (this module, SimLoop): events order by ``(time, seq)``
+  — same-instant events run in push order, i.e. the order coroutines
+  happened to schedule them. The single-run golden-hash bar
+  (PERF.md §gen) pins epoch-v1 histories.
+- **epoch-v2** (``simbatch/``, the lockstep batched generator): events
+  order by ``(time, lane, seq)`` — same-instant events drain in
+  ascending owning-lane order, push order only as the final tiebreak.
+  The 16-seed golden-hash pin in tests/test_simbatch.py pins epoch-v2
+  histories, and an epoch-v2 vs epoch-v1 fuzz checks
+  *verdict* equality across workload × nemesis (histories differ
+  op-by-op across epochs — that is the point of declaring an epoch —
+  but checker verdicts must not).
+
+Runs record their generator epoch (campaign.json ``gen-epoch`` per
+row), so stored histories always re-check against the rule that
+produced them.
+
 Coroutines are plain ``async def`` functions awaiting our ``Future``s.
 """
 

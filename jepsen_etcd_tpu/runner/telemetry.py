@@ -130,11 +130,22 @@ REGISTRY = {
                                   # raised in front of node ports
         "net.dropped_conns",      # connections blackholed by a drop
                                   # rule or refused (node down)
+        "net.dropped_chunks",     # chunks discarded by a lossy-link
+                                  # drop_prob rule (netem-loss analog)
         "net.delayed_bytes",      # bytes that paid injected latency
         "net.active_rules",       # peak concurrent fault rules
                                   # (mode=max)
         "net.accept_errors",      # transient accept() failures the
                                   # proxy survived (EMFILE, ...)
+        "genbatch.cells",         # simbatch batched generation (campaign
+                                  # epoch-v2 routing + bench batched
+                                  # leg): (workload, nemesis) cells run
+        "genbatch.seeds",         # seeds generated across all cells
+        "genbatch.steps",         # lockstep columnar steps executed
+        "genbatch.events",        # history rows born as columns
+        "genbatch.ops_per_s",     # aggregate events per generation wall
+                                  # second across the batch (mode=max)
+        "genbatch.compactions",   # BatchHeap tombstone compactions
     ),
     "events": (
         "telemetry.dropped",

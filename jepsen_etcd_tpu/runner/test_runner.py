@@ -430,6 +430,30 @@ class _SharedDb:
 #: linearizability violation)
 SOAK_KEY_STRIDE = 100_000
 
+#: soak net-fault kinds the schedule accepts (``kind`` or ``kind:arg``)
+SOAK_NET_FAULTS = ("latency", "drop", "partition")
+
+
+def _apply_soak_net_fault(plane, fault: str, nodes: list) -> None:
+    """Program one window-long fault on the shared proxy plane.
+
+    Spec is ``kind`` or ``kind:arg`` — ``latency[:delta-ms]`` (plus a
+    fixed 10 ms jitter), ``drop[:probability]`` (per-chunk loss, every
+    leg), ``partition`` (first node vs the rest, peer legs only).
+    Unlike the per-window nemesis, these rules persist for the WHOLE
+    window: degradation a start/stop generator cannot express.
+    """
+    kind, _, arg = fault.partition(":")
+    if kind == "latency":
+        plane.set_latency(float(arg or 40.0), 10.0)
+    elif kind == "drop":
+        plane.set_drop_prob(float(arg or 0.05))
+    elif kind == "partition":
+        plane.partition([[nodes[0]], list(nodes[1:])])
+    else:
+        raise ValueError(f"unknown soak net fault {fault!r}; "
+                         f"kinds: {SOAK_NET_FAULTS}")
+
 
 def run_soak(opts: dict, on_window=None) -> dict:
     """Sliding-window soak: check a long-running local cluster window
@@ -456,6 +480,22 @@ def run_soak(opts: dict, on_window=None) -> dict:
             "soak mode checks a long-lived live cluster; use "
             "--client-type http/grpc with --db local (the fake-etcd "
             "stub works) or --db live")
+    # long-lived network fault schedule: window w runs ENTIRELY under
+    # schedule[w % len(schedule)], applied to the shared proxy plane
+    # before the window starts and healed after it ends — the retained
+    # cluster is what makes a whole-window fault meaningful
+    net_faults = [f for f in (base.pop("soak_net_faults", None) or []) if f]
+    if net_faults:
+        if base.get("db_mode") != "local":
+            raise ValueError(
+                "soak net faults ride the userspace proxy plane: "
+                "requires --db local")
+        for f in net_faults:
+            if f.partition(":")[0] not in SOAK_NET_FAULTS:
+                raise ValueError(f"unknown soak net fault {f!r}; "
+                                 f"kinds: {SOAK_NET_FAULTS}")
+        base["net_proxy"] = True  # the plane must exist to program
+    schedule = [None] + net_faults
     if base.get("db_mode") == "local" and not base.get("etcd_data_dir"):
         # windows >= 1 discard their freshly composed LocalDb; pin one
         # data root so the discards never mkdtemp roots of their own
@@ -484,8 +524,21 @@ def run_soak(opts: dict, on_window=None) -> dict:
                 shared = _SharedDb(test["db"])
             test["db"] = shared
             test["name"] = f"{test['name']}-soak-w{w}"
-            out = run_test_live(test)
+            fault = schedule[w % len(schedule)]
+            plane = getattr(shared, "plane", None)
+            if fault is not None:
+                if plane is None:
+                    raise ValueError(
+                        "soak net fault scheduled but the shared db "
+                        "raised no proxy plane")
+                _apply_soak_net_fault(plane, fault, sorted(o["nodes"]))
+            try:
+                out = run_test_live(test)
+            finally:
+                if fault is not None and plane is not None:
+                    plane.heal()
             summary = {"window": w, "valid?": out["valid?"],
+                       "soak-fault": fault,
                        "ops": len(out["history"]),
                        "dir": out["dir"],
                        "wall-seconds": out["wall-seconds"],

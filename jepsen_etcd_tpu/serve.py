@@ -171,6 +171,10 @@ def _campaign_rows(store_base: str) -> list[dict]:
                 "wall_s": summary.get("wall_s"),
                 "gen_rate": (sum(rates) / len(rates)) if rates
                 else None,
+                # batched lockstep generation (simbatch epoch-v2
+                # routing): aggregate events/s across each cell's seed
+                # batch, None for epoch-v1-only campaigns
+                "genbatch": summary.get("genbatch") or None,
                 "check_s": sum(r.get("check_s") or 0 for r in done),
                 "dispatches": svc_disp + local_disp,
                 "submitted": sctr.get("service.submitted"),
@@ -311,7 +315,8 @@ def aggregate_html(store_base: str) -> str:
             "PERF.md §campaign)</p>"
             "<table><tr><th>campaign</th><th>time</th><th>runs</th>"
             "<th>pool</th><th>valid?</th><th>wall</th>"
-            "<th>gen ops/s</th><th>check wall</th>"
+            "<th>gen ops/s</th><th>batched gen ops/s</th>"
+            "<th>check wall</th>"
             "<th>dispatches</th><th>amortization</th></tr>")
         for c in camps:
             when = time.strftime("%Y-%m-%d %H:%M",
@@ -320,6 +325,13 @@ def aggregate_html(store_base: str) -> str:
             rate_td = (f"<td>{rate:,.0f}</td>"
                        if isinstance(rate, (int, float))
                        else "<td class='dim'>—</td>")
+            gb = c.get("genbatch") or {}
+            gb_rate = gb.get("ops_per_s")
+            gb_td = (f"<td title='{gb.get('seeds')} seeds over "
+                     f"{gb.get('cells')} cells, {gb.get('epoch')}'>"
+                     f"{gb_rate:,.0f}</td>"
+                     if isinstance(gb_rate, (int, float)) and gb_rate
+                     else "<td class='dim'>—</td>")
             if c["submitted"]:
                 amort = (f"{c['submitted']} packs &rarr; "
                          f"{c['group_ticks']} dispatches, "
@@ -335,7 +347,7 @@ def aggregate_html(store_base: str) -> str:
                 f"<td>{html.escape(when)}</td>"
                 f"<td>{c['count']}</td><td>{c['pool']}</td>"
                 f"<td>{_badge(c['valid?'])}</td>"
-                f"<td>{c['wall_s']}s</td>{rate_td}"
+                f"<td>{c['wall_s']}s</td>{rate_td}{gb_td}"
                 f"<td>{c['check_s']:.2f}s</td>"
                 f"<td>{c['dispatches']}</td><td>{amort}</td></tr>")
         out.append("</table>")

@@ -1,0 +1,42 @@
+"""simbatch: S seeds' discrete-event simulations in lockstep columnar
+numpy steps, histories born as OpColumns (generator epoch-v2).
+
+Public surface:
+
+- :func:`generate` / :func:`generate_for_opts` — run a seed batch,
+  get back per-seed Histories (column-backed, zero conversion into the
+  checker pipeline) plus genbatch stats.
+- :class:`BatchConfig` — the stable opts→sizing mapping golden hashes
+  key on.
+- :class:`BatchHeap` — the SoA event queue (tombstone cancels, batched
+  same-instant drains, drain-order-neutral compaction).
+- :func:`history_sha` — the golden-hash function (sha256 of the
+  canonical jsonl serialization), test/bench use only: it materializes
+  op dicts, which the hot paths never do.
+
+The determinism contract (what epoch-v2 means, and why verdicts — not
+histories — must match epoch-v1) is documented in engine.py and in the
+epoch ledger in runner/sim.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .engine import (  # noqa: F401
+    GEN_EPOCH_V1,
+    GEN_EPOCH_V2,
+    STRIDE,
+    SUPPORTED_WORKLOADS,
+    BatchConfig,
+    generate,
+    generate_for_opts,
+    supports,
+)
+from .heap import DONE, BatchHeap  # noqa: F401
+
+
+def history_sha(history) -> str:
+    """Golden hash of a history: sha256 over the canonical jsonl
+    serialization (tests/bench only — materializes dicts)."""
+    return hashlib.sha256(history.to_jsonl().encode()).hexdigest()

@@ -1,0 +1,601 @@
+"""Lockstep batched generator: S seeds' client/nemesis simulations as
+columnar numpy steps, histories born as OpColumns.
+
+Where ``runner/sim.py`` interprets ONE seed's discrete-event simulation
+on the CPython event loop (epoch-v1), this engine advances S seeds at
+once: every step pops one due event per live seed from a
+:class:`~..simbatch.heap.BatchHeap`, applies the register/set client
+state machines as ``(S,)``-wide masked array ops, and appends one
+``(S,)`` column per history field. At the end, each seed's rows gather
+straight into a ``core/history.py`` OpColumns — no per-op dicts are
+ever built, so histories enter the dict-free checker pipeline with
+zero conversion.
+
+Determinism contract (generator epoch-v2; see the epoch ledger in
+runner/sim.py):
+
+- Per-seed histories are a pure function of ``(seed, BatchConfig)`` —
+  every random draw comes from that seed's own
+  ``np.random.default_rng(seed)`` block, pre-drawn before the loop, and
+  heap sequence numbers advance per seed. Batch composition (which
+  other seeds ride along, and how many) cannot perturb a history; the
+  16-seed golden-hash pin in tests/test_simbatch.py holds seed-by-seed.
+- Event times carry a lane residue (``time = t_ns * STRIDE + lane``)
+  so no two lanes of one seed ever share an instant; the epoch-v2
+  same-instant rule (ascending lane, then push seq) therefore never
+  has to arbitrate inside generated histories — it is pinned at the
+  heap level by unit tests instead.
+- The linearization point of every client op is its completion
+  instant, and completions are totally ordered per seed, so every
+  generated history is linearizable by construction. That is what
+  makes the epoch-v2 vs epoch-v1 fuzz a *verdict*-equality check:
+  histories differ op-by-op across epochs (the point of declaring an
+  epoch), but any state-machine bug here flips a checker verdict.
+
+Timeouts model indeterminacy: while a nemesis window is open, each
+completion may instead resolve as an ``info`` op (the invoke's payload,
+``{"error": "timeout"}``), the register/set state is NOT advanced, and
+the process retires exactly like epoch-v1's client error path
+(``proc += lanes``).
+
+Performance shape: per-op draw planes (f, write/cas values, latency,
+gap, timeout, payload-kind) are pre-folded into ONE ``(R, S, L, O)``
+stack so each step gathers single ``(R, S)`` slabs instead of ~10
+separate advanced-index reads. And because invoke rows carry no
+machine state — every field is a pure draw — the heap only schedules
+COMPLETION and nemesis events: each completion step emits the
+completion row *and* the next op's invoke row with its proper (later)
+timestamp, and the finish phase restores each seed's global row order
+with one argsort over the (unique) times. Step count is therefore one
+per completion, not one per history row.
+"""
+
+from __future__ import annotations
+
+from bisect import insort
+
+import numpy as np
+
+from ..core.history import History, OpColumns
+from .heap import EPOCH_V1, EPOCH_V2, BatchHeap
+
+GEN_EPOCH_V1 = EPOCH_V1
+GEN_EPOCH_V2 = EPOCH_V2
+
+SUPPORTED_WORKLOADS = ("register", "set")
+
+#: lane-residue stride: event times are ``t_ns * STRIDE + lane``, so
+#: lane count (clients + 1 nemesis lane) must stay below it
+STRIDE = 64
+
+KIND_INVOKE = 0
+KIND_COMPLETE = 1
+KIND_NEM = 2
+
+# history type codes (core/history.py _TYPE_CODES order)
+TC_INVOKE, TC_OK, TC_FAIL, TC_INFO = 0, 1, 2, 3
+
+# payload kinds: how a row's (va, vb, vc) int slots decode to a value
+PK_REG_RD_INV = 1
+PK_REG_RD_OK = 2
+PK_REG_WR_INV = 3
+PK_REG_WR_OK = 4
+PK_REG_CAS_INV = 5
+PK_REG_CAS_OK = 6
+PK_REG_CAS_FAIL = 7
+PK_SET_ADD = 8
+PK_SET_RD_INV = 9
+PK_SET_RD_OK = 10
+PK_NEM = 11
+
+# register f codes (f_table prefix) / set f codes
+FC_READ, FC_WRITE, FC_CAS = 0, 1, 2
+FC_ADD, FC_SRD = 0, 1
+
+#: per-fault probability that a completion inside an open nemesis
+#: window resolves as a timeout info instead
+P_TIMEOUT = {"partition": 0.25, "latency": 0.06, "kill": 0.18}
+P_TIMEOUT_DEFAULT = 0.12
+
+#: stale-read injection rate (inject_stale_reads knob; the draw is
+#: always made so the knob cannot shift any other draw)
+STALE_P = 0.25
+
+#: ns between a nemesis invoke and its :info (fault apply latency)
+NEM_APPLY_NS = 2_000_000
+#: fault/heal cycles per nemesis per run
+NEM_CYCLES = 4
+
+#: nemesis start-op values by fault kind (stop value is always None),
+#: mirroring nemesis/faults.py specs
+NEM_START_VALUE = {
+    "partition": "majority",
+    "latency": {"delta-ms": 40.0, "jitter-ms": 8.0},
+}
+
+
+def supports(workload: str) -> bool:
+    return workload in SUPPORTED_WORKLOADS
+
+
+class BatchConfig:
+    """Sizing + workload knobs; with a seed, fully determines one
+    history. ``from_opts`` is the stable opts→config mapping the
+    campaign router and bench use (changing it would re-key every
+    pinned golden hash — bump the epoch instead)."""
+
+    __slots__ = ("workload", "nemeses", "lanes", "readers", "keys",
+                 "ops_per_lane", "rate", "key_offset",
+                 "inject_stale_reads")
+
+    def __init__(self, workload="register", nemeses=(), lanes=8,
+                 ops_per_lane=64, rate=200.0, keys=None, readers=None,
+                 key_offset=0, inject_stale_reads=False):
+        if workload not in SUPPORTED_WORKLOADS:
+            raise ValueError(f"simbatch does not support workload "
+                             f"{workload!r} (supported: "
+                             f"{SUPPORTED_WORKLOADS})")
+        self.workload = workload
+        self.nemeses = tuple(nemeses or ())
+        self.lanes = max(2, min(int(lanes), STRIDE - 2))
+        r = int(readers) if readers is not None else self.lanes // 2
+        self.readers = max(1, min(self.lanes - 1, r))
+        k = int(keys) if keys is not None else max(1, self.lanes // 4)
+        self.keys = max(1, k)
+        self.ops_per_lane = max(2, int(ops_per_lane))
+        self.rate = float(rate) if rate else 200.0
+        self.key_offset = int(key_offset)
+        self.inject_stale_reads = bool(inject_stale_reads)
+
+    @classmethod
+    def from_opts(cls, opts: dict) -> "BatchConfig":
+        nodes = opts.get("nodes") or ["n1", "n2", "n3"]
+        conc = int(opts.get("concurrency") or 2 * len(nodes))
+        lanes = max(2, min(conc, 16))
+        rate = float(opts.get("rate") or 200.0)
+        tl = float(opts.get("time_limit") or 30.0)
+        total = max(2 * lanes, int(tl * rate))
+        return cls(
+            workload=opts.get("workload", "register"),
+            nemeses=tuple(opts.get("nemesis") or ()),
+            lanes=lanes,
+            ops_per_lane=max(2, total // lanes),
+            rate=rate,
+            key_offset=int(opts.get("key_offset") or 0),
+            inject_stale_reads=bool(opts.get("inject_stale_reads")),
+        )
+
+    def f_table(self) -> list:
+        base = (["read", "write", "cas"] if self.workload == "register"
+                else ["add", "read"])
+        for kind in self.nemeses:
+            base.append(f"start-{kind}")
+            base.append(f"stop-{kind}")
+        return base
+
+    def nem_f_base(self) -> int:
+        return 3 if self.workload == "register" else 2
+
+
+def _draws(config: BatchConfig, seeds) -> dict:
+    """Pre-draw every random block, one independent generator per seed.
+
+    Draw ORDER and SHAPES are part of the epoch: they depend only on
+    the config, never on simulation outcomes, so per-seed streams stay
+    aligned and histories stay pure functions of (seed, config). The
+    stale-read block is always drawn (even when injection is off) so
+    the knob cannot shift any other draw.
+    """
+    L, O = config.lanes, config.ops_per_lane
+    ncy = NEM_CYCLES
+    gap_ns = max(1_000_000, int(config.lanes * 1e9 / config.rate))
+    # rough per-lane span drives nemesis cycle spacing
+    span = O * (gap_ns + 3_000_000)
+    w_lo, w_hi = max(1, span // (3 * ncy)), max(2, span // (2 * ncy))
+    cols = {k: [] for k in ("start", "fsel", "wval", "cold", "cnew",
+                            "lat", "gap", "tmo", "stale",
+                            "nwait", "nhold", "nkind")}
+    nnem = max(1, len(config.nemeses))
+    for sd in seeds:
+        rng = np.random.default_rng(int(sd))
+        cols["start"].append(rng.integers(0, gap_ns, L))
+        cols["fsel"].append(rng.integers(0, 2, (L, O)))
+        cols["wval"].append(rng.integers(0, 5, (L, O)))
+        cols["cold"].append(rng.integers(0, 5, (L, O)))
+        cols["cnew"].append(rng.integers(0, 5, (L, O)))
+        cols["lat"].append(rng.integers(1_000_000, 5_000_000, (L, O)))
+        cols["gap"].append(rng.integers(gap_ns // 2,
+                                        gap_ns + gap_ns // 2, (L, O)))
+        cols["tmo"].append(rng.random((L, O)))
+        cols["stale"].append(rng.random((L, O)))
+        cols["nwait"].append(rng.integers(w_lo, w_hi, ncy))
+        cols["nhold"].append(rng.integers(w_lo, w_hi, ncy))
+        cols["nkind"].append(rng.integers(0, nnem, ncy))
+    return {k: np.stack(v) for k, v in cols.items()}
+
+
+# draw-plane rows of the folded (R, S, L, O) per-op stack
+_CF, _CWV, _CCO, _CCN, _CLAT, _CGAP, _CPKI, _CVAI, _CVBI, _CTMO, \
+    _CSTALE = range(11)
+
+# the invoke-row slice gathered per step for the NEXT op
+_INV_PLANES = np.array([_CF, _CPKI, _CVAI, _CVBI, _CLAT])[:, None]
+_IF, _IPKI, _IVAI, _IVBI, _ILAT = range(5)
+
+
+def generate(config: BatchConfig, seeds) -> dict:
+    """Run S seeds' simulations in lockstep; return their histories
+    born columnar.
+
+    Returns ``{"histories": [History per seed], "epoch": "epoch-v2",
+    "seeds": [...], "events": int, "steps": int, "compactions": int}``.
+    """
+    seeds = [int(s) for s in seeds]
+    S = len(seeds)
+    if S == 0:
+        return {"histories": [], "epoch": GEN_EPOCH_V2, "seeds": [],
+                "events": 0, "steps": 0, "compactions": 0}
+    L, O, K = config.lanes, config.ops_per_lane, config.keys
+    NL = L  # nemesis lane id (time residue); L <= STRIDE - 2
+    is_register = config.workload == "register"
+    has_nem = bool(config.nemeses)
+    inject_stale = config.inject_stale_reads
+    d = _draws(config, seeds)
+    AR = np.arange(S)
+
+    # lane roles: first `readers` lanes read-only, the rest write
+    readers = config.readers
+    key_of_lane = (np.arange(L, dtype=np.int64) % K if is_register
+                   else np.full(L, -1, np.int64))
+    if is_register:
+        # readers: f=read; writers alternate write/cas by fsel
+        fop = np.where(np.arange(L)[None, :, None] < readers,
+                       FC_READ, FC_WRITE + d["fsel"])
+        pki = np.where(fop == FC_READ, PK_REG_RD_INV,
+                       np.where(fop == FC_WRITE, PK_REG_WR_INV,
+                                PK_REG_CAS_INV))
+        vai = np.where(fop == FC_WRITE, d["wval"],
+                       np.where(fop == FC_CAS, d["cold"], -1))
+        vbi = np.where(fop == FC_CAS, d["cnew"], -1)
+    else:
+        fop = np.where(np.arange(L)[None, :, None] < readers,
+                       FC_SRD, FC_ADD)
+        # per-seed-unique add values: op_index * writers + writer_rank
+        wrank = np.arange(L, dtype=np.int64) - readers  # <0 for readers
+        nwriters = L - readers
+        addval = (np.arange(O, dtype=np.int64)[None, None, :] * nwriters
+                  + np.where(wrank < 0, 0, wrank)[None, :, None])
+        pki = np.where(fop == FC_ADD, PK_SET_ADD, PK_SET_RD_INV)
+        vai = np.where(fop == FC_ADD, addval, -1)
+        vbi = np.full_like(vai, -1)
+    planes = [fop, d["wval"], d["cold"], d["cnew"],
+              d["lat"] * STRIDE, d["gap"] * STRIDE, pki, vai, vbi,
+              (d["tmo"] * 1e9).astype(np.int64),
+              (d["stale"] < STALE_P).astype(np.int64)]
+    CL = np.stack([np.broadcast_to(p, (S, L, O)) for p in planes])
+    p_by_kind = (np.array(
+        [P_TIMEOUT.get(kd, P_TIMEOUT_DEFAULT) for kd in config.nemeses]
+        or [0.0]) * 1e9).astype(np.int64)
+    nwaitE = d["nwait"] * STRIDE
+    nholdE = d["nhold"] * STRIDE
+    nkind = d["nkind"]
+    nem_apply = NEM_APPLY_NS * STRIDE
+    nfb = config.nem_f_base()
+
+    # lane residues make per-seed event times unique, so the heap can
+    # skip epoch-ordinal bookkeeping (identical results, cheaper steps)
+    heap = BatchHeap(S, capacity=NL + 1, epoch=GEN_EPOCH_V2,
+                     unique_times=True)
+
+    # per-seed machine state
+    opi = np.zeros((S, L), np.int64)       # op index in flight per lane
+    retire = np.zeros((S, L), np.int64)    # info-retirement count
+    done_lanes = np.zeros(S, np.int64)
+    ver = np.zeros((S, K), np.int64)
+    val = np.full((S, K), -1, np.int64)    # -1 encodes "never written"
+    pver = np.zeros((S, K), np.int64)      # pre-last-write snapshot
+    pval = np.full((S, K), -1, np.int64)   # (stale-read injection)
+    nphase = np.zeros(S, np.int64)         # 0..3 nemesis phase
+    ncyci = np.zeros(S, np.int64)          # completed fault cycles
+    win_active = np.zeros(S, bool)
+    win_p = np.zeros(S, np.int64)
+    applied = [[] for _ in range(S)]       # set workload: sorted adds
+    snaps = [[] for _ in range(S)]         # set workload: read snaps
+
+    e_time, e_tc, e_fc, e_proc, e_key = [], [], [], [], []
+    e_pk, e_va, e_vb, e_vc, e_act = [], [], [], [], []
+    steps = 0
+
+    # shared constant rows (append-only; never written after creation)
+    ALL = np.ones(S, bool)
+    ZERO = np.zeros(S, np.int64)
+    NEG1 = np.full(S, -1, np.int64)
+    K_CMP = np.full(S, KIND_COMPLETE, np.int64)
+
+    # op 0 invoke rows are emitted upfront (pure draws); the heap is
+    # seeded with each lane's FIRST completion
+    startE = d["start"] * STRIDE + np.arange(L)
+    latE = CL[_CLAT]
+    for j0 in range(L):
+        e_time.append(startE[:, j0])
+        e_tc.append(ZERO)
+        e_fc.append(CL[_CF][:, j0, 0])
+        e_proc.append(np.full(S, j0, np.int64))
+        e_key.append(np.full(S, key_of_lane[j0], np.int64))
+        e_pk.append(CL[_CPKI][:, j0, 0])
+        e_va.append(CL[_CVAI][:, j0, 0])
+        e_vb.append(CL[_CVBI][:, j0, 0])
+        e_vc.append(NEG1)
+        e_act.append(ALL)
+        heap.push(startE[:, j0] + latE[:, j0, 0], j0, KIND_COMPLETE)
+    if has_nem:
+        heap.push(nwaitE[:, 0] + NL, NL, KIND_NEM)
+
+    while True:
+        t, kind, lane, act = heap.pop_min()
+        if not act.any():
+            break
+        steps += 1
+        if has_nem:
+            m_cmp = act & (kind == KIND_COMPLETE)
+            m_nem = act & ~m_cmp
+            # client-lane index for gathers; nemesis/inactive rows
+            # alias lane 0 and are masked out or overwritten below
+            j = np.where(m_cmp, lane, 0)
+        else:
+            m_cmp = act
+            j = np.where(act, lane, 0)
+        oi = opi[AR, j]
+        g = CL[:, AR, j, oi]            # ONE slab: all per-op draws
+        f = g[_CF]
+        ret = retire[AR, j]
+        row_tc = np.zeros(S, np.int64)
+        row_fc = f
+        row_proc = j + ret * L
+        row_key = key_of_lane[j]
+        row_pk = np.zeros(S, np.int64)
+        row_va = np.full(S, -1, np.int64)
+        row_vb = np.full(S, -1, np.int64)
+        row_vc = NEG1
+        row_act = act
+
+        # -- completions: timeout infos vs real outcomes --------------
+        if has_nem:
+            m_to = m_cmp & win_active & (g[_CTMO] < win_p)
+            m_ok = m_cmp & ~m_to
+            if m_to.any():
+                row_tc[m_to] = TC_INFO
+                row_pk[m_to] = g[_CPKI][m_to]
+                row_va[m_to] = g[_CVAI][m_to]
+                row_vb[m_to] = g[_CVBI][m_to]
+                retire[AR[m_to], j[m_to]] += 1
+                ret = ret + m_to  # later ops (incl. this step's
+                # eagerly-emitted next invoke) use the retired proc
+        else:
+            m_ok = m_cmp
+
+        if is_register:
+            m_r = m_ok & (f == FC_READ)
+            m_w = m_ok & (f == FC_WRITE)
+            m_c = m_ok & (f == FC_CAS)
+            if m_r.any():
+                sr, kr = AR[m_r], row_key[m_r]
+                rv, rl = ver[sr, kr], val[sr, kr]
+                if inject_stale:
+                    stale_m = g[_CSTALE][m_r] == 1
+                    rv = np.where(stale_m, pver[sr, kr], rv)
+                    rl = np.where(stale_m, pval[sr, kr], rl)
+                row_tc[m_r] = TC_OK
+                row_pk[m_r] = PK_REG_RD_OK
+                row_va[m_r] = rv
+                row_vb[m_r] = rl
+            if m_w.any():
+                sw, kw = AR[m_w], row_key[m_w]
+                wv = g[_CWV][m_w]
+                pver[sw, kw] = ver[sw, kw]
+                pval[sw, kw] = val[sw, kw]
+                nv = ver[sw, kw] + 1
+                ver[sw, kw] = nv
+                val[sw, kw] = wv
+                row_tc[m_w] = TC_OK
+                row_pk[m_w] = PK_REG_WR_OK
+                row_va[m_w] = nv
+                row_vb[m_w] = wv
+            if m_c.any():
+                sc, kc = AR[m_c], row_key[m_c]
+                co, cn = g[_CCO][m_c], g[_CCN][m_c]
+                okc = val[sc, kc] == co
+                scw, kcw = sc[okc], kc[okc]
+                pver[scw, kcw] = ver[scw, kcw]
+                pval[scw, kcw] = val[scw, kcw]
+                nv2 = ver[scw, kcw] + 1
+                ver[scw, kcw] = nv2
+                val[scw, kcw] = cn[okc]
+                row_tc[m_c] = np.where(okc, TC_OK, TC_FAIL)
+                row_pk[m_c] = np.where(okc, PK_REG_CAS_OK,
+                                       PK_REG_CAS_FAIL)
+                va_c = co.copy()
+                va_c[okc] = nv2
+                row_va[m_c] = va_c
+                row_vb[m_c] = np.where(okc, co, cn)
+                row_vc = row_vc.copy()
+                row_vc[m_c] = np.where(okc, cn, -1)
+        else:
+            m_a = m_ok & (f == FC_ADD)
+            m_s = m_ok & (f == FC_SRD)
+            if m_a.any():
+                av = g[_CVAI]
+                row_tc[m_a] = TC_OK
+                row_pk[m_a] = PK_SET_ADD
+                row_va[m_a] = av[m_a]
+                for s in np.flatnonzero(m_a).tolist():
+                    insort(applied[s], int(av[s]))
+            if m_s.any():
+                row_tc[m_s] = TC_OK
+                row_pk[m_s] = PK_SET_RD_OK
+                for s in np.flatnonzero(m_s).tolist():
+                    snaps[s].append(list(applied[s]))
+                    row_va[s] = len(snaps[s]) - 1
+
+        # -- advance lanes; eagerly emit the NEXT op's invoke row -----
+        ncur = oi + 1
+        m_adv = m_cmp & (ncur < O)
+        opi[AR[m_adv], j[m_adv]] = ncur[m_adv]
+        oi2 = oi + m_adv                 # clamped: non-adv rows inert
+        g2 = CL[_INV_PLANES, AR, j, oi2]
+        inv_t = t + g[_CGAP]
+        inv_proc = j + ret * L
+        nxt_push = m_adv
+        nxt_t = inv_t + g2[_ILAT]
+        nxt_kind = K_CMP
+        push_lane = j
+
+        # -- nemesis lane: 4-phase fault/heal windows -----------------
+        if has_nem:
+            done_lanes += m_cmp & (ncur >= O)
+        if has_nem and m_nem.any():
+            ph = nphase
+            ci = np.minimum(ncyci, NEM_CYCLES - 1)
+            nk = nkind[AR, ci]
+            m_n0 = m_nem & (ph == 0)
+            m_die = m_n0 & (done_lanes >= L)  # clients done: no window
+            m_emit = m_nem & ~m_die
+            row_act = act.copy()
+            row_act[m_die] = False
+            m_sinv = m_n0 & ~m_die
+            m_sok = m_nem & (ph == 1)
+            m_einv = m_nem & (ph == 2)
+            m_eok = m_nem & (ph == 3)
+            is_stop = (m_einv | m_eok).astype(np.int64)
+            nf = nfb + 2 * nk + is_stop
+            row_fc = np.where(m_emit, nf, row_fc)
+            row_tc[m_sok | m_eok] = TC_INFO  # invokes keep default 0
+            row_proc[m_emit] = -1
+            row_key[m_emit] = -1
+            row_pk[m_emit] = PK_NEM
+            row_va[m_emit] = nk[m_emit]
+            row_vb[m_emit] = is_stop[m_emit]
+            win_active = (win_active | m_sok) & ~m_eok
+            win_p[m_sok] = p_by_kind[nk[m_sok]]
+            ncyci = ncyci + m_eok
+            nphase = np.where(m_emit, (ph + 1) % 4, nphase)
+            n_push = m_emit & ~(m_eok & (ncyci >= NEM_CYCLES))
+            ci2 = np.minimum(ncyci, NEM_CYCLES - 1)
+            ntm = np.where(m_sinv | m_einv, t + nem_apply,
+                           np.where(m_sok, t + nholdE[AR, ci],
+                                    t + nwaitE[AR, ci2]))
+            nxt_push = nxt_push | n_push
+            nxt_t = np.where(n_push, ntm, nxt_t)
+            nxt_kind = np.where(n_push, KIND_NEM, nxt_kind)
+            push_lane = np.where(m_nem, NL, push_lane)
+
+        heap.push_slots(nxt_t, push_lane, nxt_kind, nxt_push)
+
+        # completion (or nemesis) row at t ...
+        e_time.append(t)
+        e_tc.append(row_tc)
+        e_fc.append(row_fc)
+        e_proc.append(row_proc)
+        e_key.append(row_key)
+        e_pk.append(row_pk)
+        e_va.append(row_va)
+        e_vb.append(row_vb)
+        e_vc.append(row_vc)
+        e_act.append(row_act)
+        # ... and the next op's invoke row at its later timestamp (the
+        # finish-phase per-seed argsort restores global time order)
+        e_time.append(inv_t)
+        e_tc.append(ZERO)
+        e_fc.append(g2[_IF])
+        e_proc.append(inv_proc)
+        e_key.append(row_key)
+        e_pk.append(g2[_IPKI])
+        e_va.append(g2[_IVAI])
+        e_vb.append(g2[_IVBI])
+        e_vc.append(NEG1)
+        e_act.append(m_adv)
+
+    histories, events = _finish(config, seeds, e_time, e_tc, e_fc,
+                                e_proc, e_key, e_pk, e_va, e_vb, e_vc,
+                                e_act, snaps)
+    return {"histories": histories, "epoch": GEN_EPOCH_V2,
+            "seeds": seeds, "events": events, "steps": steps,
+            "compactions": heap.compactions}
+
+
+def _finish(config, seeds, e_time, e_tc, e_fc, e_proc, e_key, e_pk,
+            e_va, e_vb, e_vc, e_act, snaps):
+    """Gather each seed's rows (sorted by its unique event times) into
+    an OpColumns-backed History."""
+    S = len(seeds)
+    f_table = config.f_table()
+    key_table = ([config.key_offset + i for i in range(config.keys)]
+                 if config.workload == "register" else [])
+    proc_table = ["nemesis"]
+    nem_start = [NEM_START_VALUE.get(kd, "all")
+                 for kd in config.nemeses] or [None]
+    if not e_tc:
+        empty = np.zeros(0, np.int64)
+        return [History.from_columns(OpColumns(
+            empty.astype(np.int8), empty.astype(np.int32), empty,
+            empty, empty, empty, [], {}, {}, f_table, key_table,
+            proc_table)) for _ in range(S)], 0
+    TM, TC = np.stack(e_time), np.stack(e_tc)
+    FC, PR, KID = np.stack(e_fc), np.stack(e_proc), np.stack(e_key)
+    PK, VA, VB = np.stack(e_pk), np.stack(e_va), np.stack(e_vb)
+    VC, ACT = np.stack(e_vc), np.stack(e_act)
+    events = int(ACT.sum())
+    out = []
+    for s in range(S):
+        rows = np.flatnonzero(ACT[:, s])
+        tm = TM[rows, s]
+        rows = rows[np.argsort(tm)]  # unique times: total order
+        n = rows.size
+        tc = TC[rows, s]
+        pk_l = PK[rows, s].tolist()
+        va_l = VA[rows, s].tolist()
+        vb_l = VB[rows, s].tolist()
+        vc_l = VC[rows, s].tolist()
+        tc_l = tc.tolist()
+        snap = snaps[s]
+        values = [None] * n
+        extras: dict = {}
+        for i in range(n):
+            p = pk_l[i]
+            if p == PK_REG_RD_INV:
+                values[i] = [None, None]
+            elif p == PK_REG_RD_OK:
+                v = vb_l[i]
+                values[i] = [va_l[i], None if v < 0 else v]
+            elif p == PK_REG_WR_INV:
+                values[i] = [None, va_l[i]]
+            elif p == PK_REG_WR_OK:
+                values[i] = [va_l[i], vb_l[i]]
+            elif p == PK_REG_CAS_INV:
+                values[i] = [None, [va_l[i], vb_l[i]]]
+            elif p == PK_REG_CAS_OK:
+                values[i] = [va_l[i], [vb_l[i], vc_l[i]]]
+            elif p == PK_REG_CAS_FAIL:
+                values[i] = [None, [va_l[i], vb_l[i]]]
+                extras[i] = {"error": "did-not-succeed"}
+            elif p == PK_SET_ADD:
+                values[i] = va_l[i]
+            elif p == PK_SET_RD_OK:
+                values[i] = snap[va_l[i]]
+            elif p == PK_NEM:
+                values[i] = None if vb_l[i] else nem_start[va_l[i]]
+            # PK_SET_RD_INV: value stays None
+            if tc_l[i] == TC_INFO and p != PK_NEM:
+                extras[i] = {"error": "timeout"}
+        cols = OpColumns(
+            tc.astype(np.int8), FC[rows, s].astype(np.int32),
+            PR[rows, s], KID[rows, s], TM[rows, s] // STRIDE,
+            np.arange(n, dtype=np.int64), values, extras, {},
+            f_table, key_table, proc_table)
+        out.append(History.from_columns(cols))
+    return out, events
+
+
+def generate_for_opts(opts: dict, seeds) -> dict:
+    """Campaign/bench entry: opts→config mapping plus generate."""
+    return generate(BatchConfig.from_opts(opts), seeds)

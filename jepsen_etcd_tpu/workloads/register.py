@@ -16,6 +16,7 @@ from ..client import with_errors
 from ..generators import independent, mix, reserve, limit
 from ..models import VersionedRegister
 from ..checkers import compose, independent_checker
+from ..checkers.session import SessionGuarantees
 from ..checkers.tpu_linearizable import CPU_CUTOFF, TPULinearizableChecker
 from .base import WorkloadClient
 
@@ -88,6 +89,11 @@ def workload(opts: dict) -> dict:
                 lambda: VersionedRegister(0, None),
                 cpu_cutoff=None if opts.get("force_kernel")
                 else CPU_CUTOFF),
+            # session guarantees (monotone reads, writes-follow-reads)
+            # over the version payloads: strictly weaker than "linear"
+            # but localizes WHICH session saw an anomaly, and cheap
+            # enough (one vectorized pass) to run on every history
+            "session": SessionGuarantees(),
         })),
         "generator": independent.concurrent_generator(
             group,

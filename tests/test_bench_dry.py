@@ -41,6 +41,20 @@ def test_dry_set_cell():
     assert cell["attempts"] > 0
 
 
+def test_dry_gen_throughput_cell():
+    """Tier-1 guard on the batched bench leg's structure: a 16-seed
+    batch generates deterministically, born-columnar, with
+    self-consistent genbatch stats (timings asserted only by the real
+    bench run, never here)."""
+    res = run_dry("--cell", "gen_throughput")
+    cell = res["dry"]["gen_throughput"]
+    assert cell["ok"] is True and cell["check"] == "_dry_gen_throughput"
+    assert cell["ops"] > 0 and cell["events"] > 0
+    batched = cell["batched"]
+    assert batched["seeds"] == 16
+    assert batched["events"] > 0 and batched["steps"] > 0
+
+
 def test_dry_streaming_cell():
     res = run_dry("--cell", "streaming_overlap")
     cell = res["dry"]["streaming_overlap"]
