@@ -28,7 +28,8 @@ CONCURRENCY = 8
 BASELINE_SECONDS = 60.0  # CPU Knossos budget it cannot meet
 
 
-def _sim_keys(keys, ops_per_key, concurrency, seed, name, nodes=None):
+def _sim_keys(keys, ops_per_key, concurrency, seed, name, nodes=None,
+              extra=None):
     """Simulated register histories for a key list (virtual time).
     Returns ({key: History}, gen_s, total_ops) — the ONE scaffolding
     both the single-key and batched cells build on."""
@@ -51,6 +52,7 @@ def _sim_keys(keys, ops_per_key, concurrency, seed, name, nodes=None):
         # count applies re-encodes the whole store and triggers
         # follower installs)
         "snapshot_count": 100_000,
+        **(extra or {}),
     })
     test["name"] = name
     test["client"] = RegisterClient()
@@ -67,9 +69,10 @@ def _sim_keys(keys, ops_per_key, concurrency, seed, name, nodes=None):
 
 
 def sim_register_history(n_ops, concurrency, seed=2026, name="bench",
-                         nodes=None):
+                         nodes=None, extra=None):
     """n_ops on ONE key via the simulated cluster (fast: virtual time)."""
-    subs, _, _ = _sim_keys([0], n_ops, concurrency, seed, name, nodes)
+    subs, _, _ = _sim_keys([0], n_ops, concurrency, seed, name, nodes,
+                           extra)
     return subs[0]
 
 
@@ -1000,6 +1003,85 @@ def bench_net_overhead():
             if d_ms is not None and p_ms is not None else None}
 
 
+def _telemetry_arms(n_ops, seed):
+    """(off_s, on_s, summary, records): the SAME gen + pack + check +
+    bulk-latency-hist work, once with every recorder off (run_test's
+    via ``no_telemetry``, the check half under NULL) and once fully
+    recorded (run_test's default file recorder for gen, a live
+    file-backed one with a trace id for the check half). Same seed,
+    warmup check first so neither timed arm pays compilation."""
+    import os
+    import tempfile
+    from jepsen_etcd_tpu.ops import wgl
+    from jepsen_etcd_tpu.runner import telemetry
+
+    def check_half(h, tel):
+        # run_test resets the process-current recorder on exit, so
+        # (re)install the arm's before the device half
+        telemetry.set_current(tel)
+        p = wgl.pack_register_history(h)
+        assert p.ok, p.reason
+        out = wgl.check_packed(p)
+        assert out["valid?"] is True, out
+        # the campaign's per-row distribution cost: one bulk fold of
+        # R synthetic per-op latencies through the log2 hist
+        telemetry.current().hist_many(
+            "op.latency.write",
+            [1e-4 + (i % 97) * 1e-6 for i in range(p.R)])
+        return p
+
+    prev = telemetry.current()
+    try:
+        # --- off arm (also warms the compile cache for this shape) --
+        t0 = time.time()
+        h = sim_register_history(n_ops, CONCURRENCY, seed=seed,
+                                 name="bench-tel-overhead",
+                                 nodes=["n1", "n2", "n3"],
+                                 extra={"no_telemetry": True})
+        gen_off_s = time.time() - t0
+        p = wgl.pack_register_history(h)
+        assert p.ok, p.reason
+        wgl.check_packed(p)  # warmup: compile + first search
+        t0 = time.time()
+        check_half(h, telemetry.NULL)
+        off_s = gen_off_s + (time.time() - t0)
+        # --- on arm: everything recorded -----------------------------
+        t0 = time.time()
+        h = sim_register_history(n_ops, CONCURRENCY, seed=seed,
+                                 name="bench-tel-overhead",
+                                 nodes=["n1", "n2", "n3"])
+        gen_on_s = time.time() - t0
+        with tempfile.TemporaryDirectory() as td:
+            tel = telemetry.Telemetry(os.path.join(td, "tel.jsonl"),
+                                      trace="bench-tel")
+            t0 = time.time()
+            check_half(h, tel)
+            on_s = gen_on_s + (time.time() - t0)
+            telemetry.set_current(telemetry.NULL)
+            tel.close()
+            summary = tel.summary()
+            records = tel.records
+    finally:
+        telemetry.set_current(prev)
+    return off_s, on_s, summary, records
+
+
+def bench_telemetry_overhead():
+    """Observability cell: what the trace plane costs on the
+    register_50k path — recorder on (file-backed, trace id, hists)
+    vs off (NULL), same seed, same work. The percentage is REPORTED,
+    never asserted: the cell keeps the telemetry plane honest about
+    its own overhead, it is not a gate."""
+    off_s, on_s, summary, records = _telemetry_arms(67_500, seed=23)
+    pct = 100.0 * (on_s - off_s) / max(off_s, 1e-9)
+    note(f"telemetry-overhead: off={off_s:.3f}s on={on_s:.3f}s "
+         f"({pct:+.1f}%, {records} records)")
+    return {"value": round(pct, 2), "unit": "overhead_pct",
+            "off_s": round(off_s, 4), "on_s": round(on_s, 4),
+            "records": records,
+            "hists": sorted((summary.get("hists") or {}).keys())}
+
+
 CELLS = [("register_100", bench_register_100),
          ("engine_crossover", bench_engine_crossover),
          ("deep_wgl_4n_2000", bench_deep_wgl),
@@ -1015,6 +1097,7 @@ CELLS = [("register_100", bench_register_100),
          ("watch_edit_distance", bench_watch),
          ("streaming_overlap", bench_streaming_overlap),
          ("net_overhead", bench_net_overhead),
+         ("telemetry_overhead", bench_telemetry_overhead),
          ("campaign_amortization", bench_campaign_amortization)]
 
 
@@ -1296,6 +1379,25 @@ def _dry_net_overhead():
             "verdicts_identical": True}
 
 
+def _dry_telemetry_overhead():
+    """Tiny two-arm run: both arms complete, the on-arm summary
+    carries the trace id, the op-latency hist (count == ops), and the
+    wgl spans — structure only, the overhead number is never
+    asserted."""
+    off_s, on_s, summary, records = _telemetry_arms(600,
+                                                    seed=_DRY_SEED)
+    assert summary.get("trace") == "bench-tel", summary.get("trace")
+    hists = summary.get("hists") or {}
+    assert "op.latency.write" in hists, sorted(hists)
+    assert hists["op.latency.write"]["count"] > 0, hists
+    assert any(n.startswith("wgl.") for n in summary.get("spans")
+               or {}), sorted(summary.get("spans") or {})
+    assert records > 0, records
+    assert off_s > 0 and on_s > 0, (off_s, on_s)
+    return {"records": records,
+            "hist_count": hists["op.latency.write"]["count"]}
+
+
 DRY_CHECKS = {"register_100": _dry_register,
               "engine_crossover": _dry_register,
               "deep_wgl_4n_2000": _dry_register,
@@ -1311,6 +1413,7 @@ DRY_CHECKS = {"register_100": _dry_register,
               "watch_edit_distance": _dry_watch,
               "streaming_overlap": _dry_streaming,
               "net_overhead": _dry_net_overhead,
+              "telemetry_overhead": _dry_telemetry_overhead,
               "campaign_amortization": _dry_campaign,
               "register_10k": _dry_register}
 

@@ -106,12 +106,19 @@ class Perf(Checker):
         h = history if isinstance(history, History) else History(history)
         pts = latency_points(h)
         stats = {}
+        from ..runner import telemetry
+        tel = telemetry.current()
         for f, rows in pts.items():
             oks = [lat for _, lat, t in rows if t == "ok"]
             stats[f] = {
                 "count": len(rows),
                 "ok-latency-ms": quantiles(oks),
             }
+            if oks:
+                # per-class latency distribution in SECONDS (virtual
+                # time in sim mode); campaign rows merge these
+                tel.hist_many(f"op.latency.{f}",
+                              [lat / 1e3 for lat in oks])
         cols = getattr(h, "columns", None)
         if cols is not None and len(cols):
             duration = (int(cols.time.max()) or 1) / SECOND

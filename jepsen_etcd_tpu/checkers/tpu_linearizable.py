@@ -190,15 +190,25 @@ class TPULinearizableChecker(Checker):
         if svc.endpoint_for(test) is None:
             return None
         client = svc.client_for(test)
-        outs = client.check(packs) if client is not None else None
+        tel = telemetry.current()
+        # ship the run's trace id with the packs: the service stamps
+        # it on the coalesced dispatch span, making the shipped ==
+        # submitted ledger joinable per run
+        outs = client.check(packs, trace=tel.trace) \
+            if client is not None else None
         if outs is None:
-            telemetry.current().counter("service.fallback")
+            tel.counter("service.fallback")
         else:
             # producer-side ledger: what THIS run shipped. Summed over
             # a campaign's runs, service.shipped must equal the
             # service's own service.submitted (the e2e test pins it).
-            telemetry.current().counter("service.checks")
-            telemetry.current().counter("service.shipped", len(packs))
+            tel.counter("service.checks")
+            tel.counter("service.shipped", len(packs))
+            wait = getattr(client, "last_queue_wait_s", None)
+            if wait is not None:
+                # this run's share of the service's total queue wait
+                tel.counter("service.queue_wait_s", wait)
+                tel.hist("service.queue_wait_s", wait)
         return outs
 
     def _finalize(self, history, out: dict, pack=None,

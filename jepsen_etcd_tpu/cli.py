@@ -156,6 +156,10 @@ def build_parser() -> argparse.ArgumentParser:
                       help="skip the shared checker service: every "
                            "worker dispatches its own device checks "
                            "(pays the per-run dispatch floor)")
+    camp.add_argument("--no-live", action="store_true",
+                      help="skip the live telemetry collector (no "
+                           "live.sock/live.json, /live shows no "
+                           "campaign); runs record exactly as before")
     camp.add_argument("--service-tick", type=float, default=0.05,
                       help="checker-service coalescing window in "
                            "seconds: pending packs from all runners "
@@ -208,6 +212,27 @@ def build_parser() -> argparse.ArgumentParser:
     gw.add_argument("--grpc", action="store_true",
                     help="serve native gRPC (etcdserverpb) instead of "
                          "the JSON gateway")
+    tl = sub.add_parser("tel",
+                        help="mine telemetry artifacts offline: span "
+                             "percentile tables (default), --diff "
+                             "two runs, --ledger a campaign dir, or "
+                             "--coverage feature vectors; never "
+                             "touches the jax backend")
+    tl.add_argument("paths", nargs="+",
+                    help="telemetry.jsonl/service.jsonl files, run "
+                         "dirs, campaign dirs, or a store base "
+                         "(--coverage)")
+    tl.add_argument("--diff", action="store_true",
+                    help="compare spans across exactly two inputs")
+    tl.add_argument("--ledger", action="store_true",
+                    help="verify a campaign's shipped/queue-wait/"
+                         "trace-join accounting (exit 1 on mismatch)")
+    tl.add_argument("--coverage", action="store_true",
+                    help="emit the per-run + aggregate coverage "
+                         "vector (frontier, rungs, spills, verdict "
+                         "signatures)")
+    tl.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
     return p
 
 
@@ -313,6 +338,9 @@ def main(argv=None) -> int:
     if args.command == "serve":
         from .serve import serve_store
         return serve_store(args.store, args.port, args.bind)
+    if args.command == "tel":
+        from .tel_cli import run as tel_run
+        return tel_run(args)
     if args.command == "gateway":
         log = logging.getLogger("jepsen_etcd_tpu")
         if args.grpc:
@@ -377,6 +405,7 @@ def main(argv=None) -> int:
                 "checker_service"),
             service_tick_s=args.service_tick,
             store_base=args.store, name=args.campaign_name,
+            live=not args.no_live,
             on_row=_print_row)
         svc_counters = ((out.get("service") or {}).get("counters")
                         or {})

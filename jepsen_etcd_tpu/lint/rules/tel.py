@@ -32,7 +32,8 @@ RULES = {
     "TEL002": "telemetry name not in the runner/telemetry.py REGISTRY",
 }
 
-_KIND = {"span": "spans", "counter": "counters", "event": "events"}
+_KIND = {"span": "spans", "counter": "counters", "event": "events",
+         "hist": "hists", "hist_many": "hists"}
 
 
 def _name_arg(node: ast.Call) -> Tuple[Optional[str], bool]:

@@ -30,13 +30,243 @@ from __future__ import annotations
 import json
 import logging
 import os
+import socket
+import threading
 import time
+from collections import deque
 from typing import Optional
 
 from .store import _scrub, link_latest, make_store_dir
-from .telemetry import Telemetry
+from .telemetry import Hist, Telemetry
 
 logger = logging.getLogger("jepsen_etcd_tpu.campaign")
+
+#: datagram backlog bound for the live collector: past it records are
+#: shed and counted (live.dropped) — the fleet never blocks on the
+#: dashboard
+LIVE_QUEUE_MAX = 8192
+
+#: live.json snapshot cadence (seconds)
+LIVE_SNAPSHOT_S = 0.5
+
+
+class LiveCollector:
+    """Bounded, lossy aggregation of the fleet's live telemetry.
+
+    Campaign workers and the checker service stream their records as
+    JSON datagrams to an AF_UNIX socket this collector owns (see
+    ``Telemetry(sink=...)``); two threads (receive -> bounded queue ->
+    fold) turn them into an atomic ``live.json`` snapshot that
+    ``serve.py /live`` tails over SSE. Everything here is best-effort:
+    a slow collector sheds datagrams (counted), a torn or non-JSON
+    datagram is counted and skipped, and the campaign's correctness
+    artifacts never depend on this path. All shared state is mutated
+    under ``_cv`` only.
+    """
+
+    def __init__(self, cdir: str, trace: Optional[str] = None):
+        self.dir = cdir
+        self.path = os.path.join(cdir, "live.sock")
+        self.json_path = os.path.join(cdir, "live.json")
+        self.trace = trace
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._stopped = False
+        self.records = 0
+        self.dropped = 0
+        self.bad = 0
+        # fold state (all under _cv): per-run progress, service
+        # occupancy, summed counters, merged histograms
+        self._runs: dict = {}
+        self._service: dict = {}
+        self._counters: dict = {}
+        self._hists: dict = {}
+        self._sock: Optional[socket.socket] = None
+        self._threads: list = []
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "LiveCollector":
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        s.bind(self.path)
+        s.settimeout(0.25)  # poll the stop flag; close() never hangs
+        try:  # a deeper kernel buffer before the queue bound engages
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
+        except OSError:
+            pass
+        recv = threading.Thread(target=self._recv_loop,
+                                name="campaign-live-recv", daemon=True)
+        fold = threading.Thread(target=self._fold_loop,
+                                name="campaign-live-fold", daemon=True)
+        with self._cv:
+            self._sock = s
+            self._threads = [recv, fold]
+        recv.start()
+        fold.start()
+        self._snapshot()  # /live has something to show immediately
+        return self
+
+    def close(self) -> dict:
+        """Stop both threads, write the final ``done`` snapshot, and
+        return ``{records, dropped, bad}``."""
+        with self._cv:
+            if not self._stopped:
+                self._stopped = True
+                self._cv.notify_all()
+            threads = list(self._threads)
+            sock = self._sock
+        for t in threads:
+            t.join(timeout=10)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._snapshot(done=True)
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        with self._cv:
+            return {"records": self.records, "dropped": self.dropped,
+                    "bad": self.bad}
+
+    # -- receive side --------------------------------------------------------
+    def _recv_loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._stopped:
+                    return
+                sock = self._sock
+            try:
+                data, _ = sock.recvfrom(1 << 20)
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # socket closed by close()
+            with self._cv:
+                if len(self._queue) >= LIVE_QUEUE_MAX:
+                    self.dropped += 1
+                else:
+                    self._queue.append(data)
+                    self._cv.notify_all()
+
+    # -- fold side -----------------------------------------------------------
+    def _fold_loop(self) -> None:
+        last_snap = 0.0
+        while True:
+            with self._cv:
+                if not self._queue and not self._stopped:
+                    # bounded wait, not until-work: idle campaigns
+                    # still refresh the snapshot's heartbeat
+                    self._cv.wait(timeout=LIVE_SNAPSHOT_S)
+                if self._stopped and not self._queue:
+                    return
+                batch = list(self._queue)
+                self._queue.clear()
+            for data in batch:
+                try:
+                    rec = json.loads(data.decode("utf-8", "replace"))
+                    if not isinstance(rec, dict):
+                        raise ValueError("record is not an object")
+                except (ValueError, UnicodeDecodeError):
+                    with self._cv:
+                        self.bad += 1
+                    continue
+                self._fold(rec)
+            now = time.monotonic()
+            if now - last_snap >= LIVE_SNAPSHOT_S:
+                self._snapshot()
+                last_snap = now
+
+    def _fold(self, rec: dict) -> None:
+        # _cv is a Condition over an RLock, so this nests under the
+        # drain loop's hold too
+        kind = rec.get("kind")
+        name = rec.get("name") or ""
+        trace = rec.get("trace")
+        with self._cv:
+            self.records += 1
+            if kind == "span":
+                if trace is not None:
+                    st = self._runs.setdefault(trace, {"spans": 0})
+                    st["spans"] += 1
+                    st["last"] = name
+                    if name.startswith("phase:"):
+                        st["phase"] = name[len("phase:"):]
+                if name == "service.tick":
+                    attrs = rec.get("attrs") or {}
+                    self._service = {
+                        "ticks": self._service.get("ticks", 0) + 1,
+                        "packs": attrs.get("packs"),
+                        "requests": attrs.get("requests"),
+                        "groups": attrs.get("groups"),
+                        "runs": attrs.get("runs"),
+                        "device": attrs.get("device"),
+                    }
+                dur = rec.get("dur_s")
+                if name in ("wgl.check_packed", "stream.chunk",
+                            "service.tick") and isinstance(dur,
+                                                           (int, float)):
+                    self._hists.setdefault(name, Hist()).record(dur)
+            elif kind == "counter":
+                v = rec.get("value")
+                if isinstance(v, (int, float)):
+                    self._counters[name] = \
+                        self._counters.get(name, 0) + v
+            elif kind == "hist":
+                # a run (or the service) closed and flushed its
+                # histograms: merge them so /live sparklines cover op
+                # latencies too
+                key = ("op.latency.*" if name.startswith("op.latency.")
+                       else name)
+                self._hists.setdefault(key, Hist()).merge(
+                    Hist.from_dict(rec))
+            elif kind == "event" and name == "campaign.run" \
+                    and trace is not None:
+                st = self._runs.setdefault(trace, {"spans": 0})
+                st.update(rec.get("attrs") or {})
+
+    def note_row(self, row: dict) -> None:
+        """Driver-side fold of a finished row (authoritative status —
+        works even when every datagram was shed)."""
+        trace = row.get("trace")
+        if trace is None:
+            return
+        with self._cv:
+            st = self._runs.setdefault(trace, {"spans": 0})
+            st["status"] = row.get("status")
+            st["valid"] = row.get("valid")
+            st["index"] = row.get("index")
+        self._snapshot()
+
+    def _snapshot(self, done: bool = False) -> None:
+        """Atomically publish live.json (tmp + rename; readers never
+        see a torn file)."""
+        with self._cv:
+            snap = {
+                "campaign": self.trace,
+                "t": time.time(),
+                "records": self.records,
+                "dropped": self.dropped,
+                "bad": self.bad,
+                "runs": {k: dict(v) for k, v in self._runs.items()},
+                "service": dict(self._service),
+                "counters": dict(self._counters),
+                "hists": {k: h.to_dict()
+                          for k, h in self._hists.items()},
+                "done": done,
+            }
+        tmp = self.json_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(snap, f, default=repr)
+            os.replace(tmp, self.json_path)
+        except OSError:
+            pass  # dashboard-only artifact: never fail the campaign
 
 
 def campaign_specs(base_opts: dict, workloads: list,
@@ -56,6 +286,43 @@ def campaign_specs(base_opts: dict, workloads: list,
     return specs
 
 
+#: the campaign-row histogram groups (ISSUE 14 acceptance: per-row
+#: p50/p95/p99 for gen, check, and queue-wait): label -> matcher over
+#: the run summary's hist names
+_ROW_HIST_GROUPS = (
+    ("gen", lambda n: n.startswith("op.latency.")),
+    ("check", lambda n: n == "wgl.check_packed"),
+    ("queue_wait", lambda n: n == "service.queue_wait_s"),
+)
+
+
+def _row_hists(tel_sum: dict) -> tuple[dict, dict]:
+    """(hists, p) for one run's telemetry summary: per-group merged
+    sparse histograms and their [p50, p95, p99] triples. Groups with
+    no observations are omitted."""
+    hists = tel_sum.get("hists") or {}
+    out_h: dict = {}
+    out_p: dict = {}
+    for label, match in _ROW_HIST_GROUPS:
+        ds = [d for n, d in hists.items() if match(n)]
+        if not ds:
+            continue
+        h = Hist()
+        for d in ds:
+            h.merge(Hist.from_dict(d))
+        d = h.to_dict()
+        out_h[label] = d
+        out_p[label] = [d["p50"], d["p95"], d["p99"]]
+    return out_h, out_p
+
+
+def _row_net(counters: dict) -> dict:
+    """The lossy-link diagnosis triple surfaced on /aggregate."""
+    return {"dropped_chunks": int(counters.get("net.dropped_chunks", 0)),
+            "accept_errors": int(counters.get("net.accept_errors", 0)),
+            "delayed_bytes": int(counters.get("net.delayed_bytes", 0))}
+
+
 def _pool_run(spec: dict) -> dict:
     """One campaign run, executed inside a pool worker (top-level so
     spawn can pickle it by module path). Returns a compact summary row
@@ -63,6 +330,7 @@ def _pool_run(spec: dict) -> dict:
     opts = dict(spec["opts"])
     row: dict = {"index": spec["index"], "workload": opts.get("workload"),
                  "nemesis": opts.get("nemesis"), "seed": opts.get("seed"),
+                 "trace": opts.get("trace_id"),
                  # histories from a live cluster are observed, not
                  # generated — no generator epoch applies there
                  "gen-epoch": (None if opts.get("client_type")
@@ -82,6 +350,7 @@ def _pool_run(spec: dict) -> dict:
     tel = (out.get("results") or {}).get("telemetry") or {}
     counters = tel.get("counters") or {}
     phases = tel.get("phases") or {}
+    hists, percentiles = _row_hists(tel)
     row.update(
         status="done", valid=out["valid?"], dir=out["dir"],
         ops=len(out["history"]), wall_s=round(out["wall-seconds"], 3),
@@ -91,8 +360,12 @@ def _pool_run(spec: dict) -> dict:
                        + counters.get("mxu.dispatches", 0)),
         service_fallbacks=int(counters.get("service.fallback", 0)),
         service_shipped=int(counters.get("service.shipped", 0)),
+        service_queue_wait_s=round(
+            counters.get("service.queue_wait_s", 0.0), 6),
         engines={k[len("engine."):]: v for k, v in counters.items()
                  if k.startswith("engine.")},
+        net=_row_net(counters),
+        hists=hists, p=percentiles,
     )
     return row
 
@@ -153,6 +426,7 @@ def _run_batched_cell(cell_specs: list, tel: Telemetry,
                      "workload": opts.get("workload"),
                      "nemesis": opts.get("nemesis"),
                      "seed": opts.get("seed"),
+                     "trace": opts.get("trace_id"),
                      "gen-epoch": gen["epoch"]}
         t0 = wall_time.time()
         run_tel = None
@@ -183,6 +457,7 @@ def _run_batched_cell(cell_specs: list, tel: Telemetry,
         tel_sum = (out.get("results") or {}).get("telemetry") or {}
         counters = tel_sum.get("counters") or {}
         phases = tel_sum.get("phases") or {}
+        hists, percentiles = _row_hists(tel_sum)
         row.update(
             status="done", valid=out["valid?"], dir=out["dir"],
             ops=len(out["history"]),
@@ -193,8 +468,12 @@ def _run_batched_cell(cell_specs: list, tel: Telemetry,
                            + counters.get("mxu.dispatches", 0)),
             service_fallbacks=int(counters.get("service.fallback", 0)),
             service_shipped=int(counters.get("service.shipped", 0)),
+            service_queue_wait_s=round(
+                counters.get("service.queue_wait_s", 0.0), 6),
             engines={k[len("engine."):]: v for k, v in counters.items()
                      if k.startswith("engine.")},
+            net=_row_net(counters),
+            hists=hists, p=percentiles,
         )
         rows.append(row)
     return rows
@@ -233,22 +512,48 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
                  service: bool = True, service_tick_s: float = 0.05,
                  store_base: str = "store", name: str = "campaign",
                  start_method: str = "spawn",
+                 live: bool = True,
                  on_row=None) -> dict:
     """Run a campaign: every spec through the pool, one shared checker
     service (optional), one summary. ``pool=0`` runs specs inline in
     this process (the bench serial baseline). Returns the summary dict
-    also written to ``<campaign dir>/campaign.json``."""
+    also written to ``<campaign dir>/campaign.json``.
+
+    The campaign mints a trace id (``<name>-<dir id>``); each run gets
+    ``<campaign trace>.r<index>`` stamped on every telemetry record,
+    and the service carries ``<campaign trace>.svc`` — the artifacts
+    join across processes by those ids. With ``live=True`` (default) a
+    :class:`LiveCollector` aggregates the fleet's records into
+    ``live.json`` for serve.py's ``/live`` page as the campaign runs."""
     t0 = time.monotonic()
     cdir = make_store_dir(store_base, name)
-    tel = Telemetry(os.path.join(cdir, "telemetry.jsonl"))
+    trace = f"{name}-{os.path.basename(cdir)}"
+    tel = Telemetry(os.path.join(cdir, "telemetry.jsonl"), trace=trace)
     svc = None
+    svc_tel = None
+    collector = None
     failures: list = []
     rows: list = [None] * len(specs)
     service_stats = None
     try:
+        if live:
+            try:
+                collector = LiveCollector(cdir, trace=trace).start()
+            except OSError:
+                logger.warning("live collector unavailable; campaign "
+                               "continues without /live", exc_info=True)
+                collector = None
         if service:
             from .checker_service import CheckerService
-            svc = CheckerService(tick_s=service_tick_s).start()
+            # the service gets its own on-disk stream (service.jsonl in
+            # the campaign dir): tick spans carry the contributing run
+            # trace ids, which summaries don't preserve
+            svc_tel = Telemetry(
+                os.path.join(cdir, "service.jsonl"),
+                trace=f"{trace}.svc", parent=trace,
+                sink=None if collector is None else collector.path)
+            svc = CheckerService(tick_s=service_tick_s,
+                                 tel=svc_tel).start()
         run_specs = []
         for i, s in enumerate(specs):
             s = dict(s)
@@ -257,6 +562,10 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
             # runs store as siblings of the campaign dir (same base),
             # so the serve.py run index and rotation see them
             opts.setdefault("store_base", store_base)
+            opts["trace_id"] = f"{trace}.r{s['index']}"
+            opts["trace_parent"] = trace
+            if collector is not None:
+                opts["live_sink"] = collector.path
             if svc is not None:
                 opts["checker_service"] = svc.path
             s["opts"] = opts
@@ -278,16 +587,22 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
                 pooled.append(s)
         genbatch = {"cells": 0, "seeds": 0, "events": 0,
                     "ops_per_s": 0.0, "epoch": None}
+
+        def _row_done(row: dict) -> None:
+            rows[row["index"]] = row
+            fail = _tally_row(tel, row)
+            if fail is not None:
+                failures.append(fail)
+            if collector is not None:
+                collector.note_row(row)
+            if on_row is not None:
+                on_row(row)
+
         with tel.span("campaign.sweep", runs=len(run_specs),
                       pool=pool, service=bool(svc)):
             for cell_specs in cells.values():
                 for row in _run_batched_cell(cell_specs, tel, genbatch):
-                    rows[row["index"]] = row
-                    fail = _tally_row(tel, row)
-                    if fail is not None:
-                        failures.append(fail)
-                    if on_row is not None:
-                        on_row(row)
+                    _row_done(row)
             run_specs = pooled
             if pool and pool > 0:
                 import concurrent.futures as cf
@@ -297,27 +612,19 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
                                             mp_context=ctx) as ex:
                     futs = [ex.submit(_pool_run, s) for s in run_specs]
                     for fut in cf.as_completed(futs):
-                        row = fut.result()
-                        rows[row["index"]] = row
-                        fail = _tally_row(tel, row)
-                        if fail is not None:
-                            failures.append(fail)
-                        if on_row is not None:
-                            on_row(row)
+                        _row_done(fut.result())
             else:
                 for s in run_specs:
-                    row = _pool_run(s)
-                    rows[row["index"]] = row
-                    fail = _tally_row(tel, row)
-                    if fail is not None:
-                        failures.append(fail)
-                    if on_row is not None:
-                        on_row(row)
+                    _row_done(_pool_run(s))
         if svc is not None:
             service_stats = svc.stats()
     finally:
         if svc is not None:
             svc.close()
+        if svc_tel is not None:
+            # flush the service stream (counters + hists) to disk; the
+            # campaign owns this recorder, not the service
+            svc_tel.close()
     if service_stats is not None:
         # fold the service's counters (service.* coalescing accounting
         # AND the wgl./mxu. dispatch counters its device work accrued)
@@ -327,13 +634,29 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
             tel.counter(cname, value,
                         mode="max" if cname == "service.batch_occupancy"
                         else "sum")
+    if collector is not None:
+        lstats = collector.close()
+        tel.counter("live.records", lstats["records"])
+        tel.counter("live.dropped", lstats["dropped"] + lstats["bad"])
+    # campaign-wide distributions: every row's sparse histograms merge
+    # bucket-wise (the Hist contract), giving fleet p50/p95/p99 per
+    # group next to the per-row triples
+    merged: dict = {}
+    for row in rows:
+        for label, d in ((row or {}).get("hists") or {}).items():
+            merged.setdefault(label, Hist()).merge(Hist.from_dict(d))
+    hist_summaries = {label: h.to_dict() for label, h in merged.items()}
     summary = {
         "name": name, "dir": cdir, "count": len(specs),
         "pool": pool,
+        "trace": trace,
         "valid?": not failures,
         "failures": failures,
         "genbatch": genbatch if genbatch["cells"] else None,
         "runs": rows,
+        "hists": hist_summaries,
+        "p": {label: [d["p50"], d["p95"], d["p99"]]
+              for label, d in hist_summaries.items()},
         "wall_s": round(time.monotonic() - t0, 3),
         "service": None if service_stats is None else {
             "socket": svc.path, **service_stats},

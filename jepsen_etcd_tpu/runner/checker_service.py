@@ -106,17 +106,41 @@ def _plain(x: Any) -> Any:
 
 
 class _Request:
-    """One pending check request: its packs, arrival time, and the
-    connection to answer on."""
+    """One pending check request: its packs, arrival time, the
+    originating run's trace id, and the connection to answer on."""
 
-    __slots__ = ("conn", "wlock", "req_id", "packs", "t_arrive")
+    __slots__ = ("conn", "wlock", "req_id", "packs", "t_arrive",
+                 "trace")
 
-    def __init__(self, conn, wlock, req_id, packs, t_arrive):
+    def __init__(self, conn, wlock, req_id, packs, t_arrive,
+                 trace=None):
         self.conn = conn
         self.wlock = wlock
         self.req_id = req_id
         self.packs = packs
         self.t_arrive = t_arrive
+        self.trace = trace
+
+
+#: memo for _device_name — mutated in place (idempotent value, so a
+#: racing double-compute is benign and no module global is rebound)
+_device_name_cache: dict = {}
+
+
+def _device_name() -> str:
+    """``platform+id`` of the device this service dispatches on
+    (``tpu0``, ``cpu0``); the attribution key ROADMAP #3's sharded
+    service will carry per shard."""
+    name = _device_name_cache.get("name")
+    if name is None:
+        try:
+            import jax
+            d = jax.devices()[0]
+            name = f"{d.platform}{d.id}"
+        except Exception:
+            name = "host0"
+        _device_name_cache["name"] = name
+    return name
 
 
 class CheckerService:
@@ -278,7 +302,7 @@ class CheckerService:
             packs.append(wgl.deserialize_packed(frame[off:off + size]))
             off += size
         req = _Request(conn, wlock, head.get("id"), packs,
-                       time.monotonic())
+                       time.monotonic(), trace=head.get("trace"))
         self.tel.counter("service.requests")
         self.tel.counter("service.submitted", len(packs))
         with self._cv:
@@ -312,16 +336,22 @@ class CheckerService:
                 slots.append((ri, j))
         groups = {(wgl.bucket(p.R), wgl.info_dims(p), p.w)
                   for p in all_packs if p.ok and p.R > 0}
+        runs = sorted({req.trace for req in batch
+                       if req.trace is not None})
+        dev = _device_name()
         # the device work runs under the SERVICE's telemetry (deep
-        # wgl code reaches the recorder via telemetry.current()), so
-        # wgl.dispatches / mxu.dispatches land in the service summary
-        # next to the service.* coalescing counters they explain
-        prev = telemetry.current()
-        telemetry.set_current(self.tel)
+        # wgl code reaches the recorder via telemetry.current()).
+        # Pin it to THIS thread only: a process-global swap (the old
+        # set_current/restore pair) had a window where a concurrent
+        # in-process checker thread recorded into the service stream —
+        # and restored a stale recorder over a newer one. The
+        # thread-local pin cannot race: other threads never see it.
+        telemetry.set_thread_current(self.tel)
         try:
             with self.tel.span("service.tick", packs=len(all_packs),
                                requests=len(batch),
-                               groups=len(groups)) as sp:
+                               groups=len(groups),
+                               runs=runs, device=dev) as sp:
                 try:
                     outs = wgl.check_packed_batch(all_packs)
                     err = None
@@ -330,16 +360,23 @@ class CheckerService:
                     outs, err = None, repr(e)
                 sp.set(error=err)
         finally:
-            telemetry.set_current(
-                prev if prev is not telemetry.NULL else None)
+            telemetry.set_thread_current(None)
+        busy = time.monotonic() - t_start
         self.tel.counter("service.ticks")
         self.tel.counter("service.group_ticks", len(groups))
         self.tel.counter("service.coalesced",
                          sum(1 for _ in all_packs) - len(groups))
         self.tel.counter("service.batch_occupancy", len(all_packs),
                          mode="max")
-        waits = [t_start - req.t_arrive for req in batch]
+        self.tel.counter("service.device_busy_s." + dev,
+                         round(busy, 6))
+        # each request's wait is rounded ONCE and used everywhere —
+        # the summed counter, the hist, and the per-request reply — so
+        # per-run attribution re-sums to the service total exactly
+        waits = [round(t_start - req.t_arrive, 6) for req in batch]
         self.tel.counter("service.queue_wait_s", round(sum(waits), 6))
+        for w in waits:
+            self.tel.hist("service.queue_wait_s", w)
         results_by_req: dict[int, list] = {
             ri: [None] * len(req.packs) for ri, req in enumerate(batch)}
         if outs is not None:
@@ -352,10 +389,12 @@ class CheckerService:
                 results_by_req[ri][j] = _plain(out)
         for ri, req in enumerate(batch):
             if outs is None:
-                payload = {"id": req.req_id, "error": err}
+                payload = {"id": req.req_id, "error": err,
+                           "queue_wait_s": waits[ri]}
             else:
                 payload = {"id": req.req_id,
-                           "results": results_by_req[ri]}
+                           "results": results_by_req[ri],
+                           "queue_wait_s": waits[ri]}
             try:
                 with req.wlock:
                     _send_frame(req.conn, json.dumps(payload).encode())
@@ -383,6 +422,9 @@ class CheckerClient:
         self._sock: Optional[socket.socket] = None
         self._next_id = 0
         self.broken = False
+        #: queue wait the service attributed to the LAST check() reply
+        #: (seconds); None until a reply carries one
+        self.last_queue_wait_s: Optional[float] = None
 
     def _rpc(self, head: dict, body: bytes = b"") -> dict:
         with self._lock:
@@ -430,18 +472,23 @@ class CheckerClient:
         except ServiceUnavailable:
             return None
 
-    def check(self, packs: list) -> Optional[list]:
+    def check(self, packs: list,
+              trace: Optional[str] = None) -> Optional[list]:
         """Ship packed histories; returns one verdict dict per pack
         (aligned), or None if the service failed — callers MUST then
-        check the same packs in-process."""
+        check the same packs in-process. ``trace`` is the originating
+        run's trace id: the service stamps it on the dispatch tick
+        span so the shipped-packs ledger is joinable per run."""
         from ..ops import wgl
         try:
             blobs = [wgl.serialize_packed(p) for p in packs]
-            resp = self._rpc(
-                {"op": "check", "sizes": [len(b) for b in blobs]},
-                b"".join(blobs))
+            head = {"op": "check", "sizes": [len(b) for b in blobs]}
+            if trace is not None:
+                head["trace"] = trace
+            resp = self._rpc(head, b"".join(blobs))
         except ServiceUnavailable:
             return None
+        self.last_queue_wait_s = resp.get("queue_wait_s")
         results = resp.get("results")
         if results is None or len(results) != len(packs):
             # a structured error reply (a failed tick): the transport

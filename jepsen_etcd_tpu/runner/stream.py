@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from typing import Any, Optional
 
@@ -97,7 +98,10 @@ class StreamFeed:
         if cols is None or len(cols) == 0:
             return
         with self._cv:
-            self._q.append(cols)
+            # enqueue stamp feeds the stream.chunk_lag_s histogram
+            # (host wall time only — never reaches history/verdict)
+            # graftlint: ignore[DET001] telemetry-only host timing
+            self._q.append((cols, time.monotonic()))
             if len(self._q) > self.backlog_peak:
                 self.backlog_peak = len(self._q)
             self._cv.notify()
@@ -111,7 +115,10 @@ class StreamFeed:
                     self._cv.wait()
                 if not self._q and self._closed:
                     break
-                cols = self._q.popleft()
+                cols, t_enq = self._q.popleft()
+            # graftlint: ignore[DET001] telemetry-only host timing
+            lag = time.monotonic() - t_enq
+            telemetry.current().hist("stream.chunk_lag_s", lag)
             try:
                 self._consume(cols)
             except BaseException as e:  # withdraw hints, never crash a run

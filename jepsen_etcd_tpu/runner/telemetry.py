@@ -40,16 +40,21 @@ call — and pay only an attribute lookup when telemetry is off.
 from __future__ import annotations
 
 import json
+import math
+import socket
 import threading
 import time
-from typing import Any, Optional
+from typing import Any, Iterable, Optional
 
 SCHEMA_VERSION = 1
 
 #: the pinned field sets — a record of each kind carries exactly these
+#: (traced recorders append ``trace`` and, when set, ``parent`` AFTER
+#: the pinned fields; traceless records carry exactly the tuple)
 SPAN_FIELDS = ("kind", "name", "t0", "t1", "dur_s", "attrs")
 COUNTER_FIELDS = ("kind", "name", "value")
 EVENT_FIELDS = ("kind", "name", "t", "attrs")
+HIST_FIELDS = ("kind", "name", "count", "sum", "min", "max", "buckets")
 
 #: total record cap per run: past it records are counted as dropped,
 #: never buffered (a pathological dispatch loop must not eat the disk)
@@ -82,6 +87,18 @@ REGISTRY = {
         "campaign.sweep",     # runner/campaign.py: the whole pool pass
         "service.tick",       # runner/checker_service.py: one coalesced
                               # device dispatch window
+    ),
+    "hists": (
+        "op.latency.*",       # per-op-class completion latency, seconds
+                              # (checkers/perf.py; virtual time in sim)
+        "wgl.check_packed",   # auto-hist of the span walls
+        "stream.chunk",       # auto-hist of chunk dispatch walls
+        "service.tick",       # auto-hist of service dispatch windows
+        "service.queue_wait_s",   # producer-side: this run's packs'
+                                  # submit->dispatch waits as reported
+                                  # in the service reply
+        "stream.chunk_lag_s",  # enqueue->consume delay per chunk,
+                               # runner/stream.py
     ),
     "counters": (
         "generate.ops_per_s",
@@ -116,6 +133,10 @@ REGISTRY = {
                                   # is held to (~1 dispatch per group)
         "service.batch_occupancy",  # max packs in one tick (mode=max)
         "service.queue_wait_s",   # total submit->dispatch wait
+        "service.device_busy_s.*",  # per-device busy wall attributed
+                                  # by dispatch (dev = platform+id; one
+                                  # series per chip when ROADMAP #3
+                                  # shards the service)
         "service.fallback",       # runner-side degradations to
                                   # in-process checking
         "service.checks",         # runner-side: service round-trips
@@ -146,6 +167,10 @@ REGISTRY = {
         "genbatch.ops_per_s",     # aggregate events per generation wall
                                   # second across the batch (mode=max)
         "genbatch.compactions",   # BatchHeap tombstone compactions
+        "live.records",           # campaign LiveCollector: records
+                                  # received over the live socket
+        "live.dropped",           # records shed by the bounded queue
+                                  # (backpressure, never blocking)
     ),
     "events": (
         "telemetry.dropped",
@@ -153,6 +178,136 @@ REGISTRY = {
                                   # workload, nemesis, seed, valid)
     ),
 }
+
+
+#: histogram geometry: 64 log2 buckets starting at 1 microsecond.
+#: Bucket 0 is [0, HIST_MIN] (plus any negative clock skew); bucket i
+#: covers (HIST_MIN * 2**(i-1), HIST_MIN * 2**i]. 64 doublings from
+#: 1 us tops out near 9e12 s — every latency this harness can see fits.
+HIST_MIN = 1e-6
+HIST_BUCKETS = 64
+
+#: spans whose wall durations are ALSO folded into a same-named
+#: histogram on close of each span (the hot paths ISSUE 14 names)
+HIST_SPAN_NAMES = frozenset(
+    {"wgl.check_packed", "stream.chunk", "service.tick"})
+
+
+class Hist:
+    """Fixed-geometry log2 histogram: bounded memory (64 ints), exact
+    count/sum/min/max, mergeable across runs by bucket-wise addition —
+    the HDR-histogram idea reduced to the precision dashboards need.
+    """
+
+    __slots__ = ("counts", "count", "sum", "min", "max")
+
+    def __init__(self) -> None:
+        self.counts = [0] * HIST_BUCKETS
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    @staticmethod
+    def bucket_of(value: float) -> int:
+        if not value > HIST_MIN:
+            return 0
+        # bucket i covers (MIN*2**(i-1), MIN*2**i]: upper edge inclusive
+        return max(1, min(HIST_BUCKETS - 1,
+                          int(math.ceil(math.log2(value / HIST_MIN)))))
+
+    @staticmethod
+    def bucket_edges(i: int) -> tuple:
+        """(lo, hi) of bucket i; bucket 0 starts at 0."""
+        if i <= 0:
+            return (0.0, HIST_MIN)
+        return (HIST_MIN * 2.0 ** (i - 1), HIST_MIN * 2.0 ** i)
+
+    def record(self, value: float) -> None:
+        self.counts[self.bucket_of(value)] += 1
+        self.count += 1
+        self.sum += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+
+    def record_many(self, values: Iterable[float]) -> None:
+        """Vectorized bulk insert (used for per-class op latencies,
+        tens of thousands of points per run)."""
+        import numpy as np
+        a = np.asarray(values if hasattr(values, "__len__")
+                       else list(values), dtype=np.float64).ravel()
+        a = a[np.isfinite(a)]
+        if a.size == 0:
+            return
+        idx = np.zeros(a.shape, dtype=np.int64)
+        big = a > HIST_MIN
+        if big.any():
+            idx[big] = np.clip(
+                np.ceil(np.log2(a[big] / HIST_MIN)).astype(np.int64),
+                1, HIST_BUCKETS - 1)
+        for i, c in zip(*np.unique(idx, return_counts=True)):
+            self.counts[int(i)] += int(c)
+        self.count += int(a.size)
+        self.sum += float(a.sum())
+        self.min = min(self.min, float(a.min()))
+        self.max = max(self.max, float(a.max()))
+
+    def merge(self, other: "Hist") -> "Hist":
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        return self
+
+    def percentile(self, q: float) -> Optional[float]:
+        """q in [0, 100]; linear interpolation inside the landing
+        bucket, clamped to the exact observed [min, max]."""
+        if not self.count:
+            return None
+        target = (q / 100.0) * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if not c:
+                continue
+            if cum + c >= target:
+                lo, hi = self.bucket_edges(i)
+                v = lo + ((target - cum) / c) * (hi - lo)
+                return min(max(v, self.min), self.max)
+            cum += c
+        return self.max
+
+    # -- serialization --------------------------------------------------------
+    def to_dict(self) -> dict:
+        """Sparse, mergeable, JSON-stable form used in summaries,
+        campaign rows, and ``"hist"`` records."""
+        if not self.count:
+            return {"count": 0, "sum": 0.0, "min": None, "max": None,
+                    "p50": None, "p95": None, "p99": None,
+                    "buckets": {}}
+        return {"count": self.count, "sum": round(self.sum, 9),
+                "min": round(self.min, 9), "max": round(self.max, 9),
+                "p50": round(self.percentile(50), 9),
+                "p95": round(self.percentile(95), 9),
+                "p99": round(self.percentile(99), 9),
+                "buckets": {str(i): c for i, c in enumerate(self.counts)
+                            if c}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Hist":
+        h = cls()
+        for k, c in (d.get("buckets") or {}).items():
+            h.counts[int(k)] += int(c)
+        h.count = int(d.get("count") or 0)
+        h.sum = float(d.get("sum") or 0.0)
+        if d.get("min") is not None:
+            h.min = float(d["min"])
+        if d.get("max") is not None:
+            h.max = float(d["max"])
+        return h
 
 
 class _Span:
@@ -200,6 +355,7 @@ class NullTelemetry:
     """The recorder used outside a run: every call is a no-op."""
 
     enabled = False
+    trace = None
 
     def span(self, name: str, **attrs: Any) -> _NullSpan:
         return _NULL_SPAN
@@ -209,6 +365,12 @@ class NullTelemetry:
         pass
 
     def event(self, name: str, **attrs: Any) -> None:
+        pass
+
+    def hist(self, name: str, value: float) -> None:
+        pass
+
+    def hist_many(self, name: str, values: Iterable[float]) -> None:
         pass
 
     def summary(self) -> dict:
@@ -235,7 +397,10 @@ class Telemetry:
 
     def __init__(self, path: Optional[str] = None,
                  clock=time.monotonic,
-                 max_records: int = MAX_RECORDS):
+                 max_records: int = MAX_RECORDS,
+                 trace: Optional[str] = None,
+                 parent: Optional[str] = None,
+                 sink: Optional[str] = None):
         self.path = path
         self._clock = clock
         self._fh = None
@@ -243,11 +408,24 @@ class Telemetry:
         self._max_records = max_records
         self.records = 0
         self.dropped = 0
+        #: trace identity stamped on every record (``trace``/``parent``
+        #: fields AFTER the pinned tuple; absent when trace is None)
+        self.trace = trace
+        self.parent = parent
         # name -> [count, total_s]; insertion-ordered like the file
         self._span_agg: dict[str, list] = {}
         # name -> value; mode "max" counters keep the running max
         self._counters: dict[str, float] = {}
+        # name -> Hist; flushed as "hist" records at close
+        self._hists: dict[str, Hist] = {}
         self._closed = False
+        # optional live sink: an AF_UNIX datagram socket path the
+        # campaign collector listens on; strictly best-effort — a full
+        # or missing socket drops the datagram, never blocks the run
+        self._sink_path = sink
+        self._sink_sock = None
+        self._sink_errors = 0
+        self.sink_dropped = 0
 
     # -- recording -----------------------------------------------------------
     def span(self, name: str, **attrs: Any) -> _Span:
@@ -260,6 +438,8 @@ class Telemetry:
             agg = self._span_agg.setdefault(sp.name, [0, 0.0])
             agg[0] += 1
             agg[1] += dur
+            if sp.name in HIST_SPAN_NAMES:
+                self._hists.setdefault(sp.name, Hist()).record(dur)
             self._write({"kind": "span", "name": sp.name,
                          "t0": sp.t0, "t1": t1, "dur_s": dur,
                          "attrs": sp.attrs})
@@ -281,6 +461,18 @@ class Telemetry:
             self._write({"kind": "event", "name": name,
                          "t": self._clock(), "attrs": attrs})
 
+    def hist(self, name: str, value: float) -> None:
+        """Fold one observation into the named histogram. Histograms
+        live in memory (64 ints each) and flush as one ``"hist"``
+        record at close."""
+        with self._lock:
+            self._hists.setdefault(name, Hist()).record(value)
+
+    def hist_many(self, name: str, values: Iterable[float]) -> None:
+        """Vectorized :meth:`hist` for bulk observations."""
+        with self._lock:
+            self._hists.setdefault(name, Hist()).record_many(values)
+
     def _write(self, rec: dict) -> None:
         # caller holds the lock
         if self._closed:
@@ -289,11 +481,46 @@ class Telemetry:
             self.dropped += 1
             return
         self.records += 1
-        if self.path is None:
+        self._emit(rec)
+
+    def _emit(self, rec: dict) -> None:
+        """Serialize once, append to the file and forward to the live
+        sink (both best-effort independent). Caller holds the lock and
+        has already done cap accounting."""
+        if self.path is None and self._sink_path is None:
             return
-        if self._fh is None:
-            self._fh = open(self.path, "w")
-        self._fh.write(json.dumps(rec, default=repr) + "\n")
+        if self.trace is not None:
+            rec["trace"] = self.trace
+            if self.parent is not None:
+                rec["parent"] = self.parent
+        line = json.dumps(rec, default=repr)
+        if self.path is not None:
+            if self._fh is None:
+                self._fh = open(self.path, "w")
+            self._fh.write(line + "\n")
+        if self._sink_path is not None:
+            self._sink_send(line.encode("utf-8", "replace"))
+
+    def _sink_send(self, data: bytes) -> None:
+        # caller holds the lock; drop-and-count, never block or raise
+        if self._sink_sock is None:
+            try:
+                self._sink_sock = socket.socket(
+                    socket.AF_UNIX, socket.SOCK_DGRAM)
+                self._sink_sock.setblocking(False)
+            except OSError:
+                self._sink_path = None
+                return
+        try:
+            self._sink_sock.sendto(data, self._sink_path)
+            self._sink_errors = 0
+        except (BlockingIOError, InterruptedError):
+            self.sink_dropped += 1     # receiver backlogged: shed
+        except OSError:
+            self.sink_dropped += 1
+            self._sink_errors += 1
+            if self._sink_errors >= 8:  # collector gone: stop trying
+                self._sink_path = None
 
     # -- reading -------------------------------------------------------------
     def summary(self) -> dict:
@@ -305,6 +532,8 @@ class Telemetry:
             spans = {name: {"count": c, "total_s": t}
                      for name, (c, t) in self._span_agg.items()}
             counters = dict(self._counters)
+            hists = {name: h.to_dict()
+                     for name, h in self._hists.items()}
             dropped = self.dropped
         out = {
             "schema": SCHEMA_VERSION,
@@ -317,6 +546,10 @@ class Telemetry:
                          for n, v in spans.items()
                          if n.startswith("checker:")},
         }
+        if hists:
+            out["hists"] = hists
+        if self.trace is not None:
+            out["trace"] = self.trace
         if dropped:
             out["dropped"] = dropped
         if self.path is not None:
@@ -325,42 +558,102 @@ class Telemetry:
         return out
 
     def close(self) -> None:
-        """Flush counters as records and close the stream. Idempotent."""
+        """Flush counters and histograms as records and close the
+        stream. Idempotent."""
         with self._lock:
             if self._closed:
                 return
             for name, value in self._counters.items():
                 if self.records < self._max_records:
                     self.records += 1
-                    if self.path is not None:
-                        if self._fh is None:
-                            self._fh = open(self.path, "w")
-                        self._fh.write(json.dumps(
-                            {"kind": "counter", "name": name,
-                             "value": value}) + "\n")
+                    self._emit({"kind": "counter", "name": name,
+                                "value": value})
                 else:
                     self.dropped += 1
-            if self.dropped and self._fh is not None:
-                self._fh.write(json.dumps(
-                    {"kind": "event", "name": "telemetry.dropped",
-                     "t": self._clock(),
-                     "attrs": {"dropped": self.dropped}}) + "\n")
+            for name, h in self._hists.items():
+                if self.records < self._max_records:
+                    self.records += 1
+                    d = h.to_dict()
+                    self._emit({"kind": "hist", "name": name,
+                                "count": d["count"], "sum": d["sum"],
+                                "min": d["min"], "max": d["max"],
+                                "buckets": d["buckets"]})
+                else:
+                    self.dropped += 1
+            if self.dropped:
+                self._emit({"kind": "event",
+                            "name": "telemetry.dropped",
+                            "t": self._clock(),
+                            "attrs": {"dropped": self.dropped}})
             self._closed = True
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
+            if self._sink_sock is not None:
+                try:
+                    self._sink_sock.close()
+                except OSError:
+                    pass
+                self._sink_sock = None
+
+
+def load_jsonl(path: str) -> tuple:
+    """Read a ``*.jsonl`` artifact tolerantly: ``(records, skipped)``.
+
+    A killed run (or a reader racing the writer) leaves a truncated
+    trailing line; readers must skip-and-count, never crash. Non-dict
+    rows and undecodable bytes count as skipped too."""
+    records: list = []
+    skipped = 0
+    try:
+        fh = open(path, "rb")
+    except OSError:
+        return records, skipped
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line.decode("utf-8", "replace"))
+            except ValueError:
+                skipped += 1
+                continue
+            if not isinstance(rec, dict):
+                skipped += 1
+                continue
+            records.append(rec)
+    return records, skipped
 
 
 #: the process-current recorder; NULL outside a run
 _current: Any = NULL
 
+#: per-thread override: a service dispatcher (or any worker thread
+#: that must record into its own stream) pins its recorder here
+#: WITHOUT touching the process-global — concurrent threads keep
+#: recording into theirs, closing the swap race checker_service.py
+#: used to have
+_tls = threading.local()
+
 
 def current() -> Any:
-    """The active run's Telemetry, or the no-op NULL outside a run."""
-    return _current
+    """The calling thread's pinned Telemetry if one is set (see
+    :func:`set_thread_current`), else the process-current recorder,
+    else the no-op NULL outside a run."""
+    tel = getattr(_tls, "tel", None)
+    return tel if tel is not None else _current
 
 
 def set_current(tel: Optional[Telemetry]) -> None:
     """Install (or with None, clear) the process-current recorder."""
     global _current
     _current = tel if tel is not None else NULL
+
+
+def set_thread_current(tel: Optional[Telemetry]) -> None:
+    """Pin (or with None, unpin) a recorder for THIS thread only.
+    ``current()`` prefers the thread pin over the process-global, so a
+    long-lived worker thread can record into its own stream while
+    other threads' runs stay untouched."""
+    _tls.tel = tel

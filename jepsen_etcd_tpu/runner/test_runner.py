@@ -44,11 +44,17 @@ def _tally_generate(tel, history, wall_s: float) -> None:
 
 def _make_telemetry(test: dict, store_dir: str):
     """Install the run's telemetry recorder (``--no-telemetry`` opts
-    out; every other run writes telemetry.jsonl with no flag needed)."""
+    out; every other run writes telemetry.jsonl with no flag needed).
+    A campaign-minted ``trace_id``/``trace_parent`` stamps every
+    record, and ``live_sink`` streams them to the campaign's live
+    collector socket (best-effort datagrams)."""
     if test.get("no_telemetry"):
         return None
     import os
-    tel = Telemetry(os.path.join(store_dir, "telemetry.jsonl"))
+    tel = Telemetry(os.path.join(store_dir, "telemetry.jsonl"),
+                    trace=test.get("trace_id"),
+                    parent=test.get("trace_parent"),
+                    sink=test.get("live_sink"))
     telemetry.set_current(tel)
     return tel
 

@@ -161,6 +161,16 @@ def _campaign_rows(store_base: str) -> list[dict]:
                 mtime = os.path.getmtime(cpath)
             except OSError:
                 mtime = 0
+            # lossy-link diagnosis triple, summed over the rows' net.*
+            # counters (runner/campaign._row_net)
+            net = {"dropped_chunks": 0, "accept_errors": 0,
+                   "delayed_bytes": 0}
+            for r in done:
+                for k in net:
+                    try:
+                        net[k] += int((r.get("net") or {}).get(k) or 0)
+                    except (TypeError, ValueError):
+                        pass
             rows.append({
                 "dir": os.path.relpath(os.path.dirname(cpath),
                                        store_base),
@@ -182,9 +192,45 @@ def _campaign_rows(store_base: str) -> list[dict]:
                 "occupancy": sctr.get("service.batch_occupancy"),
                 "fallbacks": sum(int(r.get("service_fallbacks") or 0)
                                  for r in done),
+                # campaign-wide merged-histogram percentiles
+                # ({label: [p50, p95, p99]}, seconds)
+                "p": summary.get("p") if isinstance(summary.get("p"),
+                                                    dict) else {},
+                "net": net,
             })
     rows.sort(key=lambda r: r["mtime"])
     return rows
+
+
+def _fmt_s(v) -> str:
+    """Compact seconds: us/ms/s by magnitude."""
+    if not isinstance(v, (int, float)):
+        return "—"
+    if v < 1e-3:
+        return f"{v * 1e6:.0f}us"
+    if v < 1.0:
+        return f"{v * 1e3:.1f}ms"
+    return f"{v:.2f}s"
+
+
+def _percentile_cell(p: dict) -> str:
+    """One table cell of p95 gen/check/queue-wait (p50 and p99 in the
+    title), from a campaign summary's merged-histogram ``p`` map."""
+    labels = ("gen", "check", "queue_wait")
+    if not any(isinstance(p.get(k), list) and len(p[k]) == 3
+               for k in labels):
+        return "<td class='dim'>—</td>"
+    shown, titled = [], []
+    for k in labels:
+        tri = p.get(k)
+        if isinstance(tri, list) and len(tri) == 3:
+            shown.append(_fmt_s(tri[1]))
+            titled.append(f"{k}: p50 {_fmt_s(tri[0])}, "
+                          f"p95 {_fmt_s(tri[1])}, p99 {_fmt_s(tri[2])}")
+        else:
+            shown.append("—")
+    return (f"<td title='{html.escape('; '.join(titled))}'>"
+            + "&thinsp;/&thinsp;".join(shown) + "</td>")
 
 
 def _phase_bar(phases: dict) -> str:
@@ -317,6 +363,7 @@ def aggregate_html(store_base: str) -> str:
             "<th>pool</th><th>valid?</th><th>wall</th>"
             "<th>gen ops/s</th><th>batched gen ops/s</th>"
             "<th>check wall</th>"
+            "<th>p95 gen/check/queue</th><th>net</th>"
             "<th>dispatches</th><th>amortization</th></tr>")
         for c in camps:
             when = time.strftime("%Y-%m-%d %H:%M",
@@ -332,6 +379,17 @@ def aggregate_html(store_base: str) -> str:
                      f"{gb_rate:,.0f}</td>"
                      if isinstance(gb_rate, (int, float)) and gb_rate
                      else "<td class='dim'>—</td>")
+            p_td = _percentile_cell(c.get("p") or {})
+            net = c.get("net") or {}
+            if any(net.values()):
+                net_td = (
+                    "<td title='dropped chunks / accept errors / "
+                    "delayed bytes (net.* counters)'>"
+                    f"{net.get('dropped_chunks', 0)}&thinsp;/&thinsp;"
+                    f"{net.get('accept_errors', 0)}&thinsp;/&thinsp;"
+                    f"{net.get('delayed_bytes', 0)}</td>")
+            else:
+                net_td = "<td class='dim'>—</td>"
             if c["submitted"]:
                 amort = (f"{c['submitted']} packs &rarr; "
                          f"{c['group_ticks']} dispatches, "
@@ -348,7 +406,7 @@ def aggregate_html(store_base: str) -> str:
                 f"<td>{c['count']}</td><td>{c['pool']}</td>"
                 f"<td>{_badge(c['valid?'])}</td>"
                 f"<td>{c['wall_s']}s</td>{rate_td}{gb_td}"
-                f"<td>{c['check_s']:.2f}s</td>"
+                f"<td>{c['check_s']:.2f}s</td>{p_td}{net_td}"
                 f"<td>{c['dispatches']}</td><td>{amort}</td></tr>")
         out.append("</table>")
 
@@ -555,6 +613,126 @@ def run_html(store_base: str, rel: str) -> str:
     return "".join(out)
 
 
+# -- live campaign view ------------------------------------------------------
+
+#: an SSE stream ends once the snapshot stops refreshing for this long
+#: (campaign finished without a done marker, or died) — always after
+#: serving at least one event
+LIVE_STALE_S = 15.0
+
+#: hard bound on events per SSE connection (a forgotten browser tab
+#: must not pin a handler thread forever)
+LIVE_MAX_EVENTS = 3600
+
+
+def _live_snapshot(store_base: str):
+    """``(snapshot, mtime, rel_dir)`` of the NEWEST ``live.json``
+    under the store (the running — or most recent — campaign's
+    collector output), or None when no campaign ever ran live."""
+    best = None
+    try:
+        names = os.listdir(store_base)
+    except OSError:
+        return None
+    for name in names:
+        ndir = os.path.join(store_base, name)
+        if not os.path.isdir(ndir):
+            continue
+        try:
+            ids = os.listdir(ndir)
+        except OSError:
+            continue
+        for rid in ids:
+            if os.path.islink(os.path.join(ndir, rid)):
+                continue  # the `latest` convenience symlink
+            p = os.path.join(ndir, rid, "live.json")
+            try:
+                mtime = os.path.getmtime(p)
+            except OSError:
+                continue
+            if best is None or mtime > best[1]:
+                best = (p, mtime, os.path.join(name, rid))
+    if best is None:
+        return None
+    snap = _load_json(best[0])  # atomic rename: never torn, but a
+    if not isinstance(snap, dict):  # vanished campaign dir reads None
+        return None
+    return snap, best[1], best[2]
+
+
+def live_html() -> str:
+    """The /live dashboard shell: an EventSource client that renders
+    each SSE snapshot (run states, service occupancy, histogram
+    sparklines). Static page — all data arrives over /live?sse=1."""
+    return ("<!doctype html><title>live — jepsen_etcd_tpu</title>"
+            f"<style>{_CSS}"
+            ".spark{font-family:monospace;letter-spacing:1px}"
+            "</style>"
+            '<p><a href="/">&larr; all runs</a> &middot; '
+            '<a href="/aggregate">dashboard</a></p>'
+            "<h1>Live campaign</h1>"
+            '<div id="s" class="dim">connecting…</div>'
+            "<script>\n"
+            "const BLOCKS='▁▂▃▄▅▆▇█';\n"
+            "function spark(b){const ks=Object.keys(b||{})"
+            ".map(Number);if(!ks.length)return'<span class=dim>"
+            "(empty)</span>';const lo=Math.min(...ks),"
+            "hi=Math.max(...ks);let m=0,out='';"
+            "for(let i=lo;i<=hi;i++)m=Math.max(m,b[i]||0);"
+            "for(let i=lo;i<=hi;i++){const c=b[i]||0;"
+            "out+=BLOCKS[c?Math.min(7,1+Math.floor(6*c/m)):0];}"
+            "return'<span class=spark>'+out+'</span>';}\n"
+            "function fs(v){if(v==null)return'—';"
+            "if(v<1e-3)return(v*1e6).toFixed(0)+'us';"
+            "if(v<1)return(v*1e3).toFixed(1)+'ms';"
+            "return v.toFixed(2)+'s';}\n"
+            "function render(d){\n"
+            " if(!d.active&&!d.campaign){document.getElementById('s')"
+            ".innerHTML='<p class=unk>no live campaign</p>';return;}\n"
+            " let h='<p><b>'+(d.campaign||'?')+'</b> — '+"
+            "(d.done?'<span class=ok>finished</span>':"
+            "(d.active?'<span class=ok>running</span>':"
+            "'<span class=unk>stale</span>'))+"
+            "' · '+d.records+' records'+"
+            "(d.dropped?' · <span class=bad>'+d.dropped+"
+            "' dropped</span>':'')+'</p>';\n"
+            " const runs=Object.entries(d.runs||{});\n"
+            " h+='<h2>Runs ('+runs.length+')</h2><table><tr>"
+            "<th>trace</th><th>status</th><th>phase</th>"
+            "<th>spans</th><th>valid</th></tr>';\n"
+            " runs.sort();\n"
+            " for(const[t,r]of runs){h+='<tr><td><code>'+t+"
+            "'</code></td><td>'+(r.status||'running')+'</td><td>'+"
+            "(r.phase||'—')+'</td><td>'+(r.spans||0)+'</td><td>'+"
+            "(r.valid===true?'<span class=ok>true</span>':"
+            "(r.valid===false?'<span class=bad>false</span>':'—'))+"
+            "'</td></tr>';}\n"
+            " h+='</table>';\n"
+            " const s=d.service||{};\n"
+            " if(s.ticks)h+='<h2>Checker service</h2><p>'+s.ticks+"
+            "' ticks · last: '+(s.packs||0)+' packs from '+"
+            "(s.requests||0)+' requests in '+(s.groups||0)+"
+            "' groups on <code>'+(s.device||'?')+'</code>'+"
+            "(s.runs?' · runs '+s.runs.join(', '):'')+'</p>';\n"
+            " const hists=Object.entries(d.hists||{});\n"
+            " if(hists.length){h+='<h2>Distributions</h2><table>"
+            "<tr><th>hist</th><th>n</th><th>p50</th><th>p95</th>"
+            "<th>sparkline (log2 buckets)</th></tr>';\n"
+            "  for(const[n,v]of hists){h+='<tr><td><code>'+n+"
+            "'</code></td><td>'+v.count+'</td><td>'+fs(v.p50)+"
+            "'</td><td>'+fs(v.p95)+'</td><td>'+spark(v.buckets)+"
+            "'</td></tr>';}h+='</table>';}\n"
+            " const ctr=Object.entries(d.counters||{});\n"
+            " if(ctr.length){h+='<p class=dim>'+ctr.sort()"
+            ".map(([k,v])=>k+'='+v).join(' · ')+'</p>';}\n"
+            " document.getElementById('s').innerHTML=h;}\n"
+            "const es=new EventSource('/live?sse=1');\n"
+            "es.onmessage=e=>{const d=JSON.parse(e.data);render(d);"
+            "if(d.done||!d.active)es.close();};\n"
+            "es.onerror=()=>{es.close();};\n"
+            "</script>")
+
+
 class StoreHandler(SimpleHTTPRequestHandler):
     """Serves the store dir; '/' renders the run index, '/aggregate'
     the cross-run dashboard, run dirs render report pages (?files for
@@ -573,6 +751,40 @@ class StoreHandler(SimpleHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _sse_live(self) -> None:
+        """``/live?sse=1``: push the live.json snapshot as SSE events
+        (~1/s) until the campaign is done, the snapshot goes stale, or
+        the client disconnects. Always serves at least one event —
+        ``{"active": false}`` when no campaign ever ran live."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        sent = 0
+        try:
+            while True:
+                found = _live_snapshot(self.store_base)
+                if found is None:
+                    payload, last = {"active": False}, True
+                else:
+                    snap, mtime, rel = found
+                    stale = time.time() - mtime > LIVE_STALE_S
+                    done = bool(snap.get("done"))
+                    payload = dict(snap, dir=rel,
+                                   active=not (done or stale))
+                    last = done or stale
+                self.wfile.write(
+                    b"data: "
+                    + json.dumps(payload, default=repr).encode()
+                    + b"\n\n")
+                self.wfile.flush()
+                sent += 1
+                if last or sent >= LIVE_MAX_EVENTS:
+                    return
+                time.sleep(1.0)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return  # client went away: normal for a live view
+
     def do_GET(self):
         from urllib.parse import parse_qs
         path, _, query = self.path.partition("?")
@@ -580,6 +792,10 @@ class StoreHandler(SimpleHTTPRequestHandler):
             return self._html(index_html(self.store_base))
         if path in ("/aggregate", "/aggregate/"):
             return self._html(aggregate_html(self.store_base))
+        if path in ("/live", "/live/"):
+            if "sse" in parse_qs(query, keep_blank_values=True):
+                return self._sse_live()
+            return self._html(live_html())
         qs = parse_qs(query, keep_blank_values=True)
         if path.endswith("/") and "files" not in qs:
             rel = os.path.normpath(path.strip("/"))

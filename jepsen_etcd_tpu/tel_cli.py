@@ -1,0 +1,382 @@
+"""Telemetry mining CLI (``python -m jepsen_etcd_tpu tel``).
+
+Queries telemetry artifacts offline — plain jsonl/json reads, no jax
+import, safe on any host. Four actions over one or many
+``telemetry.jsonl`` / ``service.jsonl`` / ``campaign.json`` files:
+
+  (default)    per-span percentile tables, merged hist records, and
+               counter totals across every input
+  --diff A B   side-by-side span comparison of exactly two inputs
+  --ledger D   campaign ledger verification: Σ rows' shipped packs ==
+               the service's submitted counter, per-run queue-wait
+               attribution re-sums to the service total, and every
+               shipping run's trace id appears in some service tick
+               span (the cross-process join the trace plane exists
+               to make checkable)
+  --coverage P per-run + aggregate coverage vector (peak search
+               frontier, rung escalations, host spills, verdict
+               signatures) — the features ROADMAP #5's guided
+               campaign scheduler will consume
+
+All readers are torn-line tolerant (runner.telemetry.load_jsonl) and
+report how many lines they skipped; a killed run must still be
+minable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+
+from .runner.telemetry import Hist, load_jsonl
+
+#: |Σ per-run queue waits − service total| tolerance: the waits are
+#: rounded to 1e-6 once at the service and reused verbatim on both
+#: sides, so only float summation order can introduce drift
+LEDGER_WAIT_TOL = 1e-3
+
+
+def _fmt_s(v) -> str:
+    """Human duration: 1.0e-6 -> '1.0us', 0.012 -> '12.0ms'."""
+    if v is None:
+        return "-"
+    v = float(v)
+    if v < 1e-3:
+        return f"{v * 1e6:.1f}us"
+    if v < 1.0:
+        return f"{v * 1e3:.1f}ms"
+    return f"{v:.3f}s"
+
+
+def _resolve(path: str) -> list:
+    """A CLI operand names either a jsonl file or a dir holding
+    telemetry.jsonl / service.jsonl (run dirs and campaign dirs both
+    qualify)."""
+    if os.path.isfile(path):
+        return [path]
+    if os.path.isdir(path):
+        found = [os.path.join(path, n)
+                 for n in ("telemetry.jsonl", "service.jsonl")
+                 if os.path.isfile(os.path.join(path, n))]
+        if found:
+            return found
+    raise SystemExit(f"tel: no telemetry artifacts at {path!r}")
+
+
+def scan(paths: list) -> dict:
+    """Fold a set of jsonl files into one profile: per-span duration
+    Hists, merged ``hist`` records, summed counters, trace ids seen,
+    and the skipped-line count."""
+    prof: dict = {"files": 0, "records": 0, "skipped": 0,
+                  "spans": {}, "hists": {}, "counters": {},
+                  "traces": set()}
+    for p in paths:
+        recs, skipped = load_jsonl(p)
+        prof["files"] += 1
+        prof["skipped"] += skipped
+        for rec in recs:
+            prof["records"] += 1
+            trace = rec.get("trace")
+            if trace is not None:
+                prof["traces"].add(trace)
+            kind = rec.get("kind")
+            name = rec.get("name")
+            if kind == "span" and isinstance(rec.get("dur_s"),
+                                             (int, float)):
+                prof["spans"].setdefault(name, Hist()).record(
+                    rec["dur_s"])
+            elif kind == "counter" and isinstance(rec.get("value"),
+                                                  (int, float)):
+                prof["counters"][name] = \
+                    prof["counters"].get(name, 0) + rec["value"]
+            elif kind == "hist":
+                prof["hists"].setdefault(name, Hist()).merge(
+                    Hist.from_dict(rec))
+    return prof
+
+
+def _span_rows(prof: dict) -> list:
+    rows = []
+    for name in sorted(prof["spans"]):
+        h = prof["spans"][name]
+        rows.append({"span": name, "count": h.count,
+                     "total_s": round(h.sum, 6),
+                     "p50": h.percentile(50), "p95": h.percentile(95),
+                     "p99": h.percentile(99)})
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows
+
+
+def _print_span_table(rows: list) -> None:
+    if not rows:
+        print("  (no spans)")
+        return
+    w = max(len(r["span"]) for r in rows)
+    print(f"  {'span':<{w}}  {'count':>7}  {'total':>9}  "
+          f"{'p50':>9}  {'p95':>9}  {'p99':>9}")
+    for r in rows:
+        print(f"  {r['span']:<{w}}  {r['count']:>7}  "
+              f"{_fmt_s(r['total_s']):>9}  {_fmt_s(r['p50']):>9}  "
+              f"{_fmt_s(r['p95']):>9}  {_fmt_s(r['p99']):>9}")
+
+
+def cmd_spans(paths: list, as_json: bool) -> int:
+    files: list = []
+    for p in paths:
+        files.extend(_resolve(p))
+    prof = scan(files)
+    if as_json:
+        print(json.dumps({
+            "files": prof["files"], "records": prof["records"],
+            "skipped": prof["skipped"],
+            "traces": sorted(prof["traces"]),
+            "spans": {n: dict(h.to_dict(), name=n)
+                      for n, h in prof["spans"].items()},
+            "hists": {n: h.to_dict()
+                      for n, h in prof["hists"].items()},
+            "counters": prof["counters"]}, indent=2, sort_keys=True))
+        return 0
+    print(f"{prof['files']} file(s), {prof['records']} records"
+          f" ({prof['skipped']} torn/skipped lines),"
+          f" {len(prof['traces'])} trace id(s)")
+    print("spans:")
+    _print_span_table(_span_rows(prof))
+    if prof["hists"]:
+        print("hist records:")
+        for n in sorted(prof["hists"]):
+            d = prof["hists"][n].to_dict()
+            print(f"  {n}: count={d['count']} "
+                  f"p50={_fmt_s(d['p50'])} p95={_fmt_s(d['p95'])} "
+                  f"p99={_fmt_s(d['p99'])}")
+    if prof["counters"]:
+        print("counters:")
+        for n in sorted(prof["counters"]):
+            v = prof["counters"][n]
+            v = round(v, 6) if isinstance(v, float) else v
+            print(f"  {n} = {v}")
+    return 0
+
+
+def cmd_diff(paths: list, as_json: bool) -> int:
+    if len(paths) != 2:
+        raise SystemExit("tel --diff takes exactly two inputs")
+    pa = scan(_resolve(paths[0]))
+    pb = scan(_resolve(paths[1]))
+    names = sorted(set(pa["spans"]) | set(pb["spans"]))
+    delta = []
+    for n in names:
+        ha, hb = pa["spans"].get(n), pb["spans"].get(n)
+        a95 = ha.percentile(95) if ha else None
+        b95 = hb.percentile(95) if hb else None
+        ratio = (b95 / a95) if a95 and b95 else None
+        delta.append({"span": n,
+                      "count_a": ha.count if ha else 0,
+                      "count_b": hb.count if hb else 0,
+                      "p95_a": a95, "p95_b": b95,
+                      "p95_ratio": (round(ratio, 3)
+                                    if ratio is not None else None)})
+    if as_json:
+        print(json.dumps({"a": paths[0], "b": paths[1],
+                          "skipped": [pa["skipped"], pb["skipped"]],
+                          "spans": delta}, indent=2, sort_keys=True))
+        return 0
+    print(f"A = {paths[0]}  ({pa['records']} records, "
+          f"{pa['skipped']} skipped)")
+    print(f"B = {paths[1]}  ({pb['records']} records, "
+          f"{pb['skipped']} skipped)")
+    if not delta:
+        print("  (no spans on either side)")
+        return 0
+    w = max(len(d["span"]) for d in delta)
+    print(f"  {'span':<{w}}  {'n(A)':>6}  {'n(B)':>6}  "
+          f"{'p95(A)':>9}  {'p95(B)':>9}  {'B/A':>6}")
+    for d in delta:
+        r = "-" if d["p95_ratio"] is None else f"{d['p95_ratio']:.2f}x"
+        print(f"  {d['span']:<{w}}  {d['count_a']:>6}  "
+              f"{d['count_b']:>6}  {_fmt_s(d['p95_a']):>9}  "
+              f"{_fmt_s(d['p95_b']):>9}  {r:>6}")
+    return 0
+
+
+def _load_campaign(path: str) -> tuple:
+    """(campaign dir, summary dict) from a dir or campaign.json path."""
+    if os.path.isdir(path):
+        cpath = os.path.join(path, "campaign.json")
+    else:
+        cpath, path = path, os.path.dirname(path) or "."
+    try:
+        with open(cpath) as fh:
+            summary = json.load(fh)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        raise SystemExit(f"tel: cannot read {cpath!r}: {e}")
+    if not isinstance(summary, dict) or "runs" not in summary:
+        raise SystemExit(f"tel: {cpath!r} is not a campaign summary")
+    return path, summary
+
+
+def ledger(path: str) -> dict:
+    """Verify the campaign's cross-process accounting. Three checks:
+    shipped-pack conservation, queue-wait attribution, and the
+    trace join between runner rows and service tick spans."""
+    cdir, summary = _load_campaign(path)
+    rows = [r for r in (summary.get("runs") or [])
+            if isinstance(r, dict)]
+    done = [r for r in rows if r.get("status") == "done"]
+    sctr = (summary.get("service") or {}).get("counters") or {}
+    checks = []
+
+    shipped = sum(int(r.get("service_shipped") or 0) for r in done)
+    submitted = int(sctr.get("service.submitted", 0))
+    checks.append({"check": "shipped==submitted",
+                   "ok": shipped == submitted,
+                   "detail": f"rows shipped {shipped}, "
+                             f"service submitted {submitted}"})
+
+    row_wait = sum(float(r.get("service_queue_wait_s") or 0.0)
+                   for r in done)
+    svc_wait = float(sctr.get("service.queue_wait_s", 0.0))
+    checks.append({"check": "queue_wait attribution",
+                   "ok": abs(row_wait - svc_wait) <= LEDGER_WAIT_TOL,
+                   "detail": f"rows {round(row_wait, 6)}s, "
+                             f"service {round(svc_wait, 6)}s"})
+
+    svc_log = os.path.join(cdir, "service.jsonl")
+    if os.path.isfile(svc_log):
+        recs, skipped = load_jsonl(svc_log)
+        ticked = set()
+        for rec in recs:
+            if rec.get("kind") == "span" and \
+                    rec.get("name") == "service.tick":
+                ticked.update((rec.get("attrs") or {})
+                              .get("runs") or ())
+        shippers = {r.get("trace") for r in done
+                    if int(r.get("service_shipped") or 0) > 0
+                    and r.get("trace") is not None}
+        missing = sorted(shippers - ticked)
+        checks.append({"check": "trace join (rows ⊆ tick spans)",
+                       "ok": not missing,
+                       "detail": f"{len(shippers)} shipping run(s), "
+                                 f"{len(ticked)} trace(s) in tick "
+                                 f"spans, {skipped} torn line(s)"
+                                 + (f"; missing {missing}"
+                                    if missing else "")})
+    else:
+        checks.append({"check": "trace join (rows ⊆ tick spans)",
+                       "ok": None,
+                       "detail": "no service.jsonl (service "
+                                 "disabled or inline runs)"})
+    return {"campaign": summary.get("trace") or summary.get("name"),
+            "dir": cdir, "runs": len(rows), "done": len(done),
+            "checks": checks,
+            "ok": all(c["ok"] is not False for c in checks)}
+
+
+def cmd_ledger(paths: list, as_json: bool) -> int:
+    out = ledger(paths[0])
+    if as_json:
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0 if out["ok"] else 1
+    print(f"ledger: {out['campaign']}  "
+          f"({out['done']}/{out['runs']} runs done)")
+    for c in out["checks"]:
+        mark = {True: "ok  ", False: "FAIL", None: "skip"}[c["ok"]]
+        print(f"  [{mark}] {c['check']}: {c['detail']}")
+    print("ledger verified" if out["ok"] else "LEDGER MISMATCH")
+    return 0 if out["ok"] else 1
+
+
+def _coverage_dirs(path: str) -> list:
+    """Run dirs behind a coverage operand: a campaign dir (rows'
+    dirs), a single run dir, or a store base (every run under it)."""
+    if os.path.isfile(os.path.join(path, "campaign.json")) or \
+            path.endswith("campaign.json"):
+        cdir, summary = _load_campaign(path)
+        out = []
+        for r in summary.get("runs") or []:
+            if isinstance(r, dict) and r.get("dir"):
+                d = r["dir"]
+                out.append(d if os.path.isabs(d)
+                           else os.path.join(cdir, d))
+        return out
+    if os.path.isfile(os.path.join(path, "results.json")):
+        return [path]
+    out = []
+    for root, dirs, files in os.walk(path, followlinks=False):
+        dirs[:] = [d for d in dirs
+                   if not os.path.islink(os.path.join(root, d))]
+        if "results.json" in files:
+            out.append(root)
+            dirs[:] = []
+    return sorted(out)
+
+
+def coverage(path: str) -> dict:
+    """The guided-campaign feature vector: how hard the checker had
+    to work (frontier/rungs/spills) and what verdicts the fleet
+    produced (failure-signature histogram)."""
+    from .serve import _failure_signature
+    runs = []
+    for rdir in _coverage_dirs(path):
+        try:
+            with open(os.path.join(rdir, "results.json")) as fh:
+                results = json.load(fh)
+        except (OSError, json.JSONDecodeError, ValueError):
+            continue
+        if not isinstance(results, dict):
+            continue
+        ctr = ((results.get("telemetry") or {}).get("counters")
+               or {})
+        runs.append({"dir": rdir,
+                     "valid": results.get("valid?"),
+                     "frontier": int(ctr.get("wgl.max-frontier", 0)),
+                     "rungs": int(ctr.get("wgl.rungs", 0)),
+                     "spills": int(ctr.get("wgl.host-spill", 0)),
+                     "signature": _failure_signature(results)})
+    sigs = Counter(r["signature"] for r in runs if r["signature"])
+    return {"runs": runs,
+            "aggregate": {
+                "count": len(runs),
+                "peak_frontier": max((r["frontier"] for r in runs),
+                                     default=0),
+                "rungs": sum(r["rungs"] for r in runs),
+                "spills": sum(r["spills"] for r in runs),
+                "invalid": sum(1 for r in runs
+                               if r["valid"] is not True),
+                "signatures": dict(sorted(sigs.items()))}}
+
+
+def cmd_coverage(paths: list, as_json: bool) -> int:
+    out = coverage(paths[0])
+    if as_json:
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
+    agg = out["aggregate"]
+    print(f"coverage over {agg['count']} run(s):")
+    for r in out["runs"]:
+        sig = f"  [{r['signature']}]" if r["signature"] else ""
+        print(f"  {os.path.basename(r['dir'])}: "
+              f"valid={r['valid']} frontier={r['frontier']} "
+              f"rungs={r['rungs']} spills={r['spills']}{sig}")
+    print(f"aggregate: peak_frontier={agg['peak_frontier']} "
+          f"rungs={agg['rungs']} spills={agg['spills']} "
+          f"invalid={agg['invalid']}")
+    for sig, n in agg["signatures"].items():
+        print(f"  signature x{n}: {sig}")
+    return 0
+
+
+def run(args) -> int:
+    """Entry point for the ``tel`` subcommand (cli.main dispatches
+    here before any jax import)."""
+    try:
+        if args.ledger:
+            return cmd_ledger(args.paths, args.as_json)
+        if args.coverage:
+            return cmd_coverage(args.paths, args.as_json)
+        if args.diff:
+            return cmd_diff(args.paths, args.as_json)
+        return cmd_spans(args.paths, args.as_json)
+    except BrokenPipeError:
+        # `tel ... | head` closing stdout early is normal usage
+        return 0

@@ -74,6 +74,19 @@ def test_dry_net_overhead_cell():
     assert cell["verdicts_identical"] is True
 
 
+def test_dry_telemetry_overhead_cell():
+    """Tier-1 guard on the observability cell's structure: both arms
+    run, the on-arm records into a traced recorder whose summary
+    carries the op-latency histogram — the overhead percentage itself
+    is never asserted."""
+    res = run_dry("--cell", "telemetry_overhead")
+    cell = res["dry"]["telemetry_overhead"]
+    assert cell["ok"] is True and cell["check"] == \
+        "_dry_telemetry_overhead"
+    assert cell["records"] > 0
+    assert cell["hist_count"] > 0
+
+
 def test_dry_campaign_cell():
     res = run_dry("--cell", "campaign_amortization")
     cell = res["dry"]["campaign_amortization"]

@@ -23,7 +23,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 TEL_REGISTRY = {"spans": ("phase:*", "good.span"),
                 "counters": ("a.b", "stream.*_reuse"),
-                "events": ("boom",)}
+                "events": ("boom",),
+                "hists": ("good.hist", "lat.*")}
 
 
 def lint_snippet(tmp_path, source, name="snippet.py", rules=None,
@@ -268,6 +269,22 @@ def test_tel002_wildcard_and_prefix_clean(tmp_path):
         "    tel.counter(f'stream.{name}_reuse')\n"
         "    with tel.span('phase:setup'):\n"
         "        pass\n"))
+    assert "TEL002" not in rules_fired(r)
+
+
+def test_tel002_hist_names_checked(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "def fold(tel, vals):\n"
+        "    tel.hist('h.typo', 1.0)\n"
+        "    tel.hist_many('h.typo2', vals)\n"))
+    assert "TEL002" in rules_fired(r)
+
+
+def test_tel002_registered_hist_clean(tmp_path):
+    r = lint_snippet(tmp_path, (
+        "def fold(tel, vals, f):\n"
+        "    tel.hist('good.hist', 1.0)\n"
+        "    tel.hist_many(f'lat.{f}', vals)\n"))
     assert "TEL002" not in rules_fired(r)
 
 

@@ -1,0 +1,504 @@
+"""Live fleet telemetry plane: log2 histograms, cross-process trace
+propagation, the campaign live collector + /live SSE endpoint, and the
+``tel`` mining CLI.
+
+The plane's contract is accounting that JOINS across processes: every
+record a run emits carries its campaign-minted trace id, service tick
+spans list the run traces they coalesced, per-request queue waits
+re-sum to the service's total, and every reader tolerates the torn
+trailing line a killed writer leaves behind.
+"""
+
+import glob
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from jepsen_etcd_tpu import tel_cli
+from jepsen_etcd_tpu.runner import checker_service as svc_mod
+from jepsen_etcd_tpu.runner import telemetry
+from jepsen_etcd_tpu.runner.campaign import LiveCollector, run_campaign
+from jepsen_etcd_tpu.runner.telemetry import (HIST_MIN, SPAN_FIELDS,
+                                              Hist, Telemetry,
+                                              load_jsonl)
+from jepsen_etcd_tpu.serve import make_server
+
+
+@pytest.fixture(autouse=True)
+def _isolate_current():
+    telemetry.set_current(None)
+    telemetry.set_thread_current(None)
+    yield
+    telemetry.set_current(None)
+    telemetry.set_thread_current(None)
+
+
+# -- histograms --------------------------------------------------------------
+
+def test_hist_bucket_edges():
+    assert Hist.bucket_of(0.0) == 0
+    assert Hist.bucket_of(-5.0) == 0
+    assert Hist.bucket_of(HIST_MIN) == 0
+    assert Hist.bucket_of(HIST_MIN * 2) == 1
+    assert Hist.bucket_of(HIST_MIN * 2.0001) == 2
+    assert Hist.bucket_of(1e99) == 63
+    assert Hist.bucket_edges(0) == (0.0, HIST_MIN)
+    # upper edge is inclusive, lower exclusive: edges invert bucket_of
+    for i in range(1, 63):
+        lo, hi = Hist.bucket_edges(i)
+        assert Hist.bucket_of(hi) == i
+        assert Hist.bucket_of(lo) == i - 1
+
+
+def test_hist_record_many_matches_scalar_path():
+    vals = [0.0, HIST_MIN, 3e-6, 0.01, 2.5, 0.01]
+    a, b = Hist(), Hist()
+    for v in vals:
+        a.record(v)
+    b.record_many(vals)
+    assert a.counts == b.counts
+    assert (a.count, a.min, a.max) == (b.count, b.min, b.max)
+    assert a.sum == pytest.approx(b.sum)
+
+
+def test_hist_merge_is_bucketwise_addition():
+    a, b = Hist(), Hist()
+    a.record_many([1e-4, 2e-4, 5e-3])
+    b.record_many([1e-4, 9.0])
+    merged = Hist.from_dict(a.to_dict()).merge(Hist.from_dict(
+        b.to_dict()))
+    assert merged.count == 5
+    assert merged.sum == pytest.approx(a.sum + b.sum)
+    assert merged.min == pytest.approx(1e-4)
+    assert merged.max == pytest.approx(9.0)
+    both = Hist()
+    both.record_many([1e-4, 2e-4, 5e-3, 1e-4, 9.0])
+    assert merged.counts == both.counts
+
+
+def test_hist_percentile_interpolates_and_clamps():
+    h = Hist()
+    for _ in range(4):
+        h.record(0.004)
+    # single observed value: every percentile clamps to it exactly
+    for q in (1, 50, 95, 99, 100):
+        assert h.percentile(q) == 0.004
+    h2 = Hist()
+    h2.record(0.0015)        # bucket 11: (1.024ms, 2.048ms]
+    for _ in range(3):
+        h2.record(0.004)     # bucket 12: (2.048ms, 4.096ms]
+    p50 = h2.percentile(50)
+    assert 0.002 < p50 < 0.003  # interpolated inside bucket 12
+    d = h2.to_dict()
+    assert d["buckets"] == {"11": 1, "12": 3}
+    assert d["count"] == 4
+
+
+def test_hist_empty_rendering():
+    h = Hist()
+    assert h.percentile(99) is None
+    d = h.to_dict()
+    assert d == {"count": 0, "sum": 0.0, "min": None, "max": None,
+                 "p50": None, "p95": None, "p99": None, "buckets": {}}
+    r = Hist.from_dict(d)
+    assert r.count == 0 and r.to_dict() == d
+
+
+# -- trace propagation -------------------------------------------------------
+
+def test_trace_fields_ride_after_pinned_schema(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tel = Telemetry(path, trace="camp.r1", parent="camp")
+    with tel.span("phase:generate"):
+        pass
+    tel.counter("wgl.rungs", 2)
+    tel.hist("service.queue_wait_s", 0.002)
+    tel.close()
+    recs, skipped = load_jsonl(path)
+    assert skipped == 0 and recs
+    for r in recs:
+        assert r["trace"] == "camp.r1"
+        assert r["parent"] == "camp"
+    span = next(r for r in recs if r["kind"] == "span")
+    # pinned fields first, trace identity appended after
+    assert tuple(span.keys()) == SPAN_FIELDS + ("trace", "parent")
+    hist_rec = next(r for r in recs if r["kind"] == "hist")
+    assert hist_rec["name"] == "service.queue_wait_s"
+    assert hist_rec["count"] == 1 and hist_rec["buckets"]
+    assert tel.summary()["trace"] == "camp.r1"
+
+
+def test_traceless_recorder_keeps_exact_pinned_keys(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tel = Telemetry(path)
+    with tel.span("phase:check"):
+        pass
+    tel.close()
+    recs, _ = load_jsonl(path)
+    span = next(r for r in recs if r["kind"] == "span")
+    assert tuple(span.keys()) == SPAN_FIELDS
+    assert "trace" not in tel.summary()
+
+
+def test_load_jsonl_tolerates_torn_and_junk_lines(tmp_path):
+    path = tmp_path / "t.jsonl"
+    path.write_bytes(
+        b'{"kind":"event","name":"a","t":0,"attrs":{}}\n'
+        b"[1, 2]\n"                       # decodes, not a dict
+        b"\xff\xfenot json at all\n"      # undecodable garbage
+        b'{"kind":"span","name":"phase:gen","t0":1,"t')  # torn tail
+    recs, skipped = load_jsonl(str(path))
+    assert len(recs) == 1 and recs[0]["name"] == "a"
+    assert skipped == 3
+    # a missing file is empty, never an exception
+    assert load_jsonl(str(tmp_path / "nope.jsonl")) == ([], 0)
+
+
+def test_thread_local_override_does_not_leak_across_threads(tmp_path):
+    """Pins the checker-service fix: a worker thread pinning its own
+    recorder via set_thread_current must never redirect other
+    threads' telemetry.current() (the old global set_current swap
+    did, losing main-thread records into the service stream)."""
+    a = Telemetry(str(tmp_path / "a.jsonl"))
+    b = Telemetry(str(tmp_path / "b.jsonl"), trace="svc")
+    telemetry.set_current(a)
+    errs = []
+    started = threading.Event()
+
+    def worker():
+        telemetry.set_thread_current(b)
+        started.set()
+        try:
+            for _ in range(300):
+                if telemetry.current() is not b:
+                    errs.append("worker lost its override")
+                    return
+                telemetry.current().counter("service.ticks")
+        finally:
+            telemetry.set_thread_current(None)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    started.wait(5)
+    for _ in range(300):
+        if telemetry.current() is not a:
+            errs.append("main thread redirected")
+            break
+        telemetry.current().counter("campaign.runs")
+    t.join(10)
+    assert not errs
+    a.close()
+    b.close()
+    assert a.summary()["counters"].get("campaign.runs") == 300
+    assert "service.ticks" not in a.summary()["counters"]
+    assert b.summary()["counters"].get("service.ticks") == 300
+
+
+# -- service: tick spans + queue-wait attribution ----------------------------
+
+def test_service_ticks_list_run_traces_and_waits_resum(tmp_path):
+    from test_checker_service import make_packs
+    svc_log = str(tmp_path / "service.jsonl")
+    svc_tel = Telemetry(svc_log, trace="c.svc", parent="c")
+    svc = svc_mod.CheckerService(tick_s=0.01, tel=svc_tel).start()
+    try:
+        c1 = svc_mod.CheckerClient(svc.path)
+        c2 = svc_mod.CheckerClient(svc.path)
+        packs = make_packs(5, 3)
+        assert c1.last_queue_wait_s is None
+        out1 = c1.check(packs[:2], trace="c.r0")
+        out2 = c2.check(packs[2:], trace="c.r1")
+        assert out1 is not None and out2 is not None
+        waits = [c1.last_queue_wait_s, c2.last_queue_wait_s]
+        assert all(isinstance(w, float) and w >= 0 for w in waits)
+        ctr = svc.stats().get("counters") or {}
+        # per-request attribution re-sums to the service's own total
+        assert sum(waits) == pytest.approx(
+            ctr.get("service.queue_wait_s"), abs=1e-4)
+        assert any(k.startswith("service.device_busy_s.")
+                   for k in ctr), sorted(ctr)
+        c1.close()
+        c2.close()
+    finally:
+        svc.close()
+        svc_mod.reset_clients()
+    svc_tel.close()
+    recs, skipped = load_jsonl(svc_log)
+    assert skipped == 0
+    ticks = [r for r in recs if r.get("kind") == "span"
+             and r.get("name") == "service.tick"]
+    assert ticks
+    listed = set()
+    for tk in ticks:
+        assert tk["trace"] == "c.svc" and tk["parent"] == "c"
+        attrs = tk.get("attrs") or {}
+        assert attrs.get("device")
+        listed.update(attrs.get("runs") or [])
+    assert {"c.r0", "c.r1"} <= listed
+    hist_names = {r["name"] for r in recs if r.get("kind") == "hist"}
+    assert {"service.queue_wait_s", "service.tick"} <= hist_names
+
+
+# -- live collector ----------------------------------------------------------
+
+def _wait_until(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+def test_live_collector_folds_worker_stream(tmp_path):
+    col = LiveCollector(str(tmp_path), trace="camp").start()
+    try:
+        tel = Telemetry(str(tmp_path / "r0.jsonl"), trace="camp.r0",
+                        parent="camp", sink=col.path)
+        with tel.span("phase:generate"):
+            pass
+        tel.counter("net.dropped_chunks", 3)
+        tel.hist("op.latency.write", 0.004)
+        tel.close()  # flushes the counter + hist records to the sink
+        assert tel.sink_dropped == 0
+        assert _wait_until(lambda: col.records >= 3)
+        # junk datagram: counted as bad, never kills the collector
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        s.sendto(b"not json", col.path)
+        s.close()
+        assert _wait_until(lambda: col.bad == 1)
+        col.note_row({"trace": "camp.r0", "index": 0,
+                      "status": "done", "valid": True})
+    finally:
+        stats = col.close()
+    assert stats["records"] >= 3
+    assert stats["bad"] == 1 and stats["dropped"] == 0
+    assert not os.path.exists(col.path), "socket not unlinked"
+    snap = json.load(open(os.path.join(str(tmp_path), "live.json")))
+    assert snap["done"] is True and snap["campaign"] == "camp"
+    st = snap["runs"]["camp.r0"]
+    assert st["status"] == "done" and st["valid"] is True
+    assert st["spans"] >= 1
+    assert snap["counters"].get("net.dropped_chunks") == 3
+    assert snap["hists"]["op.latency.*"]["count"] == 1
+
+
+def test_sink_to_dead_socket_never_fails_the_run(tmp_path):
+    tel = Telemetry(str(tmp_path / "t.jsonl"), trace="x",
+                    sink=str(tmp_path / "no-collector.sock"))
+    for i in range(10):
+        with tel.span("phase:generate"):
+            pass
+    tel.close()
+    recs, skipped = load_jsonl(str(tmp_path / "t.jsonl"))
+    assert skipped == 0 and len(recs) == 10
+    assert tel.sink_dropped >= 1
+
+
+# -- /live SSE ---------------------------------------------------------------
+
+@pytest.fixture
+def http_store(tmp_path):
+    srv = make_server(str(tmp_path), port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}", tmp_path
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_live_page_and_inactive_sse(http_store):
+    url, _ = http_store
+    page = urllib.request.urlopen(url + "/live",
+                                  timeout=10).read().decode()
+    assert "EventSource" in page and "sse=1" in page
+    # no campaign ever ran live: exactly one terminal event
+    body = urllib.request.urlopen(url + "/live?sse=1",
+                                  timeout=10).read().decode()
+    assert body.startswith("data: ")
+    assert json.loads(body[len("data: "):].strip()) == \
+        {"active": False}
+
+
+def test_live_sse_streams_fresh_snapshot(http_store):
+    url, base = http_store
+    cdir = base / "camp" / "00000"
+    cdir.mkdir(parents=True)
+    (cdir / "live.json").write_text(json.dumps({
+        "campaign": "camp-00000", "records": 5, "dropped": 0,
+        "bad": 0, "runs": {"camp-00000.r0": {"spans": 3,
+                                             "phase": "generate"}},
+        "service": {}, "counters": {}, "hists": {}, "done": False}))
+    resp = urllib.request.urlopen(url + "/live?sse=1", timeout=10)
+    line = resp.readline()
+    while line and not line.startswith(b"data: "):
+        line = resp.readline()
+    resp.close()
+    payload = json.loads(line[len(b"data: "):].decode())
+    assert payload["active"] is True
+    assert payload["campaign"] == "camp-00000"
+    assert payload["runs"]["camp-00000.r0"]["phase"] == "generate"
+    assert payload["dir"] == os.path.join("camp", "00000")
+
+
+# -- campaign e2e: collector + SSE mid-campaign + mining ---------------------
+
+def test_pool_campaign_live_plane_e2e(tmp_path):
+    """3 sim runs over a 2-worker pool with the live plane on: /live
+    serves an SSE update while the campaign is still running, the
+    collector's fold survives to campaign.json (trace ids, p50/95/99
+    triples, net counters), and the tel CLI's ledger + coverage both
+    verify the artifacts."""
+    specs = [{"index": i,
+              "opts": {"workload": "register", "time_limit": 1,
+                       "rate": 100.0, "seed": 11 + i,
+                       "nodes": ["n1", "n2", "n3"]}}
+             for i in range(3)]
+    res = {}
+
+    def go():
+        try:
+            res["summary"] = run_campaign(
+                specs, pool=2, service=False,
+                store_base=str(tmp_path), name="livecamp")
+        except BaseException as e:  # surfaced by the main thread
+            res["err"] = e
+
+    t = threading.Thread(target=go, daemon=True)
+    t.start()
+    live = None
+    deadline = time.time() + 120
+    while time.time() < deadline and not live:
+        found = glob.glob(os.path.join(str(tmp_path), "livecamp",
+                                       "*", "live.json"))
+        live = found[0] if found else None
+        time.sleep(0.1)
+    assert live, "collector never published live.json"
+
+    srv = make_server(str(tmp_path), port=0)
+    st = threading.Thread(target=srv.serve_forever, daemon=True)
+    st.start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        resp = urllib.request.urlopen(url + "/live?sse=1", timeout=30)
+        line = resp.readline()
+        while line and not line.startswith(b"data: "):
+            line = resp.readline()
+        resp.close()
+        payload = json.loads(line[len(b"data: "):].decode())
+        assert "active" in payload and "runs" in payload
+        assert payload["campaign"].startswith("livecamp-")
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+    t.join(timeout=600)
+    assert not t.is_alive(), "campaign hung"
+    assert "err" not in res, res.get("err")
+    summary = res["summary"]
+    assert summary["valid?"] is True
+    ctr = summary["telemetry"]["counters"]
+    assert ctr.get("live.records", 0) > 0
+    assert ctr.get("live.dropped", 0) == 0
+    for r in summary["runs"]:
+        assert r["trace"] == f"{summary['trace']}.r{r['index']}"
+        assert set(r["net"]) == {"dropped_chunks", "accept_errors",
+                                 "delayed_bytes"}
+        assert len(r["p"]["gen"]) == 3  # [p50, p95, p99]
+        assert r["hists"]["gen"]["count"] > 0
+    assert len(summary["p"]["gen"]) == 3
+    snap = json.load(open(live))
+    assert snap["done"] is True
+    assert set(snap["runs"]) >= {r["trace"] for r in summary["runs"]}
+
+    led = tel_cli.ledger(summary["dir"])
+    assert led["ok"] is True, led
+    cov = tel_cli.coverage(summary["dir"])
+    assert cov["aggregate"]["count"] == 3
+    assert cov["aggregate"]["invalid"] == 0
+
+
+# -- tel CLI -----------------------------------------------------------------
+
+def _mini_run(path, trace=None, lat=0.01):
+    tel = Telemetry(str(path), trace=trace)
+    with tel.span("phase:check"):
+        pass
+    tel.hist("service.queue_wait_s", lat)
+    tel.close()
+
+
+def test_tel_cli_spans_over_dir(tmp_path, capsys):
+    _mini_run(tmp_path / "telemetry.jsonl", trace="t1")
+    rc = tel_cli.cmd_spans([str(tmp_path)], as_json=True)
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["traces"] == ["t1"]
+    assert "phase:check" in out["spans"]
+    assert out["hists"]["service.queue_wait_s"]["count"] == 1
+    assert out["skipped"] == 0
+    # torn trailing line: counted, never fatal
+    with open(tmp_path / "telemetry.jsonl", "ab") as f:
+        f.write(b'{"kind":"span","na')
+    rc = tel_cli.cmd_spans([str(tmp_path)], as_json=True)
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["skipped"] == 1
+
+
+def test_tel_cli_diff(tmp_path, capsys):
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(), b.mkdir()
+    _mini_run(a / "telemetry.jsonl")
+    _mini_run(b / "telemetry.jsonl")
+    rc = tel_cli.cmd_diff([str(a), str(b)], as_json=True)
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    d = next(s for s in out["spans"] if s["span"] == "phase:check")
+    assert d["count_a"] == 1 and d["count_b"] == 1
+    assert d["p95_ratio"] is not None
+    with pytest.raises(SystemExit):
+        tel_cli.cmd_diff([str(a)], as_json=True)
+
+
+def test_tel_cli_ledger_flags_mismatches(tmp_path, capsys):
+    (tmp_path / "campaign.json").write_text(json.dumps({
+        "trace": "c", "runs": [
+            {"status": "done", "trace": "c.r0", "service_shipped": 5,
+             "service_queue_wait_s": 0.5}],
+        "service": {"counters": {"service.submitted": 4,
+                                 "service.queue_wait_s": 0.5}}}))
+    # service.jsonl whose ticks never list c.r0: join must fail too
+    with open(tmp_path / "service.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "span", "name": "service.tick",
+                            "t0": 0, "t1": 1, "dur_s": 1,
+                            "attrs": {"runs": ["c.r9"]}}) + "\n")
+    rc = tel_cli.cmd_ledger([str(tmp_path)], as_json=True)
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["ok"] is False
+    by = {c["check"]: c for c in out["checks"]}
+    assert by["shipped==submitted"]["ok"] is False
+    assert by["queue_wait attribution"]["ok"] is True
+    assert by["trace join (rows ⊆ tick spans)"]["ok"] is False
+
+
+def test_tel_cli_coverage_vector(tmp_path):
+    rdir = tmp_path / "etcd-register" / "00001"
+    rdir.mkdir(parents=True)
+    (rdir / "results.json").write_text(json.dumps({
+        "valid?": False, "workload": {"valid?": False},
+        "telemetry": {"counters": {"wgl.max-frontier": 17,
+                                   "wgl.rungs": 3,
+                                   "wgl.host-spill": 1}}}))
+    out = tel_cli.coverage(str(tmp_path))
+    agg = out["aggregate"]
+    assert agg["count"] == 1 and agg["peak_frontier"] == 17
+    assert agg["rungs"] == 3 and agg["spills"] == 1
+    assert agg["invalid"] == 1
+    assert agg["signatures"] == {"workload=False": 1}

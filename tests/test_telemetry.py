@@ -10,7 +10,7 @@ import pytest
 from jepsen_etcd_tpu.runner import telemetry
 from jepsen_etcd_tpu.runner.telemetry import (
     Telemetry, NullTelemetry, NULL, SPAN_FIELDS, COUNTER_FIELDS,
-    EVENT_FIELDS)
+    EVENT_FIELDS, HIST_FIELDS)
 
 
 def read_jsonl(path):
@@ -164,8 +164,11 @@ def test_run_writes_telemetry_and_reconciles(tmp_path):
     recs = read_jsonl(path)
     for r in recs:
         want = {"span": SPAN_FIELDS, "counter": COUNTER_FIELDS,
-                "event": EVENT_FIELDS}[r["kind"]]
+                "event": EVENT_FIELDS, "hist": HIST_FIELDS}[r["kind"]]
         assert tuple(r.keys()) == want
+    # perf's op-latency distributions flush as hist records at close
+    assert any(r["kind"] == "hist" and r["name"].startswith("op.latency.")
+               for r in recs)
     by_name = {}
     for r in recs:
         if r["kind"] == "span":
