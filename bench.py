@@ -917,6 +917,100 @@ def bench_campaign_amortization():
                                  / max(svc["wall_s"], 1e-9), 2)}
 
 
+def _scaling_packs(tag: str):
+    """Mixed-shape pack fleet for the service_scaling arms: three sim
+    sizes so several (bucket, width) groups exist — the placement map
+    has something to spread."""
+    from jepsen_etcd_tpu.ops import wgl
+    packs = []
+    for kk, (keys, ops, conc) in enumerate([(4, 30, 4), (4, 120, 4),
+                                            (2, 260, 6)]):
+        subs, _, _ = _sim_keys(range(keys), ops, conc, 31 + kk,
+                               f"svc-scaling-{tag}-{kk}",
+                               nodes=["n1", "n2", "n3"])
+        packs += [wgl.pack_register_history(subs[k]) for k in subs]
+    return [p for p in packs if p.ok and p.R > 0]
+
+
+def _service_scaling_arm(n_dev: int) -> dict:
+    """Child half of the service_scaling cell, spawned with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=<n>`` and
+    JAX_PLATFORMS=cpu: the mixed-shape pack fleet through a live
+    CheckerService, one warm round (compiles land on their sticky
+    chips) then timed rounds. Returns the check wall plus the
+    per-device dispatch ledger."""
+    import jax
+    from jepsen_etcd_tpu.runner import checker_service as svc_mod
+
+    assert len(jax.devices()) == n_dev, (jax.devices(), n_dev)
+    packs = _scaling_packs(str(n_dev))
+    svc = svc_mod.CheckerService(tick_s=0.01).start()
+    try:
+        client = svc_mod.CheckerClient(svc.path)
+        warm = client.check(packs)
+        assert warm is not None and len(warm) == len(packs)
+        t0 = time.time()
+        for _ in range(3):
+            assert client.check(packs) is not None
+        check_s = time.time() - t0
+        ctr = (svc.stats().get("counters") or {})
+        client.close()
+    finally:
+        svc.close()
+    disp = {k[len("service.device_dispatches."):]: v
+            for k, v in ctr.items()
+            if k.startswith("service.device_dispatches.")}
+    return {"devices": n_dev, "check_s": round(check_s, 4),
+            "packs": len(packs),
+            "group_ticks": ctr.get("service.group_ticks"),
+            "shard_fanout": ctr.get("service.shard_fanout", 0),
+            "device_dispatches": disp,
+            "occupancy": ctr.get("service.device_occupancy"),
+            "sharded_ticks": ctr.get("service.sharded_ticks", 0)}
+
+
+def _spawn_scaling_arm(n_dev: int) -> dict:
+    """Run _service_scaling_arm in a fresh process: the host device
+    count is process-global (XLA reads XLA_FLAGS once), so the 1- and
+    8-device arms cannot share this interpreter."""
+    import os
+    import subprocess
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={n_dev}"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--service-scaling-arm", str(n_dev)],
+        capture_output=True, text=True, env=env, timeout=900)
+    assert out.returncode == 0, (out.returncode, out.stderr[-2000:])
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def bench_service_scaling():
+    """Service sharding cell (PERF.md §6): the SAME mixed-shape pack
+    fleet through the checker service with 1 vs 8 host devices
+    (subprocess arms), reporting the warm check-wall ratio. The 8 fake
+    CPU devices share the same cores, so the ratio is REPORTED, never
+    asserted — the cell's durable payload is the per-device dispatch
+    ledger (every device dispatching, Σ == group_ticks + shard_fanout),
+    the structure real-hardware scaling rides on."""
+    a1 = _spawn_scaling_arm(1)
+    a8 = _spawn_scaling_arm(8)
+    for arm in (a1, a8):
+        disp = arm["device_dispatches"]
+        assert sum(disp.values()) == ((arm["group_ticks"] or 0)
+                                      + (arm["shard_fanout"] or 0)), arm
+    ratio = a1["check_s"] / max(a8["check_s"], 1e-9)
+    note(f"service-scaling: 1-dev {a1['check_s']}s vs 8-dev "
+         f"{a8['check_s']}s (ratio {ratio:.2f}x, 8-dev used "
+         f"{len(a8['device_dispatches'])} chips, occupancy "
+         f"{a8['occupancy']}, {a8['sharded_ticks']} sharded ticks)")
+    return {"value": round(ratio, 3), "unit": "check_wall_ratio_1v8",
+            "one_device": a1, "eight_device": a8,
+            "chips_used": len(a8["device_dispatches"])}
+
+
 def _mean_op_latency_ms(h):
     """Mean invoke->ok wall latency over client ops (ms), paired by
     process. Returns (mean_ms, n_ok)."""
@@ -1098,7 +1192,8 @@ CELLS = [("register_100", bench_register_100),
          ("streaming_overlap", bench_streaming_overlap),
          ("net_overhead", bench_net_overhead),
          ("telemetry_overhead", bench_telemetry_overhead),
-         ("campaign_amortization", bench_campaign_amortization)]
+         ("campaign_amortization", bench_campaign_amortization),
+         ("service_scaling", bench_service_scaling)]
 
 
 # ---------------------------------------------------------------------
@@ -1357,6 +1452,60 @@ def _dry_campaign():
             "verdicts_identical": True}
 
 
+def _dry_service_scaling():
+    """Tiny structural pass of the sharded service (no timing, no
+    subprocess arms): distinct groups land on distinct sticky devices
+    when a mesh is visible, the per-device dispatch counters balance
+    the group ledger, stats carries the device roster + placement map,
+    and every service verdict projection matches local
+    ``check_packed`` on the same pack."""
+    import jax
+    from jepsen_etcd_tpu.ops import wgl
+    from jepsen_etcd_tpu.runner import checker_service as svc_mod
+
+    packs = []
+    for kk, (keys, ops) in enumerate([(2, 30), (2, 120)]):
+        subs, _, _ = _sim_keys(range(keys), ops, 4, _DRY_SEED + kk,
+                               f"dry-svc-scaling-{kk}",
+                               nodes=["n1", "n2", "n3"])
+        packs += [wgl.pack_register_history(subs[k]) for k in subs]
+    assert all(p.ok and p.R > 0 for p in packs), \
+        [(p.ok, p.R) for p in packs]
+    local = [wgl.check_packed(p) for p in packs]
+    proj = ("valid?", "waves", "peak-frontier", "ops", "info-ops",
+            "op", "error", "stuck-at-depth")
+
+    def view(out):
+        return {k: out.get(k) for k in proj}
+
+    svc = svc_mod.CheckerService(tick_s=0.01).start()
+    try:
+        client = svc_mod.CheckerClient(svc.path)
+        outs = client.check(packs)
+        assert outs is not None, "service unreachable"
+        for got, want in zip(outs, local):
+            assert view(got) == view(want), (view(got), view(want))
+        st = svc.stats()
+        ctr = st.get("counters") or {}
+        disp = {k: v for k, v in ctr.items()
+                if k.startswith("service.device_dispatches.")}
+        assert disp, sorted(ctr)
+        assert sum(disp.values()) == \
+            (ctr.get("service.group_ticks", 0)
+             + ctr.get("service.shard_fanout", 0)), ctr
+        assert st.get("devices"), st.get("devices")
+        placement = st.get("placement") or {}
+        assert placement, st
+        if len(jax.devices()) > 1 and len(placement) > 1:
+            # sticky round-robin: distinct group shapes spread out
+            assert len(set(placement.values())) > 1, placement
+        client.close()
+    finally:
+        svc.close()
+    return {"packs": len(packs), "devices": len(jax.devices()),
+            "chips_used": len(disp), "verdicts_identical": True}
+
+
 def _dry_net_overhead():
     """Tiny proxied run vs its direct twin: the plane actually fronted
     the node's URLs (links counted, ports split listen-vs-advertise),
@@ -1415,6 +1564,7 @@ DRY_CHECKS = {"register_100": _dry_register,
               "net_overhead": _dry_net_overhead,
               "telemetry_overhead": _dry_telemetry_overhead,
               "campaign_amortization": _dry_campaign,
+              "service_scaling": _dry_service_scaling,
               "register_10k": _dry_register}
 
 
@@ -1496,9 +1646,14 @@ def main() -> int:
                     help="smoke mode: tiny sizes, structural asserts "
                          "(engine routing, packer equivalence), no "
                          "timing asserts")
+    ap.add_argument("--service-scaling-arm", type=int,
+                    help=argparse.SUPPRESS)  # subprocess child entry
     args = ap.parse_args()
     from jepsen_etcd_tpu.ops.common import enable_compile_cache
     enable_compile_cache()
+    if args.service_scaling_arm:
+        print(json.dumps(_service_scaling_arm(args.service_scaling_arm)))
+        return 0
     if args.dry:
         return run_dry(args.cell)
     _lint_gate()

@@ -338,13 +338,27 @@ class TPULinearizableChecker(Checker):
         # rides the same deferral (its batch runs spill=False), so it
         # only engages when a fallback exists to match semantics.
         out = None
-        if self.f_max is None and self.fallback:
+        svc_tried = self.f_max is None and self.fallback
+        if svc_tried:
             svc_outs = self._service_check(test, [p])
             if svc_outs is not None:
                 out = svc_outs[0]
         if out is None:
+            device = None
+            if svc_tried:
+                # service-down fallback: land the dispatch on the chip
+                # the service's sticky placement map would have picked
+                # (fallback_device_for counts it per device) instead of
+                # re-serializing onto device 0
+                from ..runner import checker_service as svc
+                if svc.endpoint_for(test) is not None:
+                    dev_for = svc.fallback_device_for(
+                        telemetry.current())
+                    if dev_for is not None:
+                        device = dev_for(wgl.group_key(p))
             out = wgl.check_packed(p, f_max=self.f_max,
-                                   spill=not self.fallback)
+                                   spill=not self.fallback,
+                                   device=device)
         return self._finalize(history, out, pack=p,
                               band=(None, small_unknown, band_budget))
 
@@ -427,7 +441,16 @@ class TPULinearizableChecker(Checker):
         if svc_outs is not None:
             outs = svc_outs
         else:
+            device_for = None
             if self.f_max is None:
+                from ..runner import checker_service as svc
+                if svc.endpoint_for(test) is not None:
+                    # service-down fallback: honor the same sticky
+                    # group→device placement the service dispatcher
+                    # runs (counted per device as service.fallback.*)
+                    # instead of re-serializing onto device 0
+                    device_for = svc.fallback_device_for(
+                        telemetry.current())
                 launched = wgl._run_fused(
                     wgl._mxu_broken, "mxu batch",
                     lambda: wgl_mxu.launch_packed_batch_mxu(packs))
@@ -443,7 +466,7 @@ class TPULinearizableChecker(Checker):
             if rest:
                 rest_outs = wgl.check_packed_batch(
                     [packs[i] for i in rest], f_max=self.f_max,
-                    try_fused=False)
+                    try_fused=False, device_for=device_for)
                 for i, out in zip(rest, rest_outs):
                     outs[i] = out
         # unpackable keys come back "unknown" with the pack reason;

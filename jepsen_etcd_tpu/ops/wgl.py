@@ -1919,14 +1919,110 @@ def _spill_bfs(p: Packed, tables, frontier, waves_done: int,
 
 
 @functools.lru_cache(maxsize=None)
-def _batched_kernel_jitted(f_max: int, w: int):
+def _batched_kernel_jitted(f_max: int, w: int, donate: bool = False):
     import jax
     kernel = functools.partial(_wgl_kernel, f_max=f_max, w=w)
+    if donate:
+        # donated table/R/I buffers let XLA reuse their device memory
+        # for the wave ladder's working set — safe because every tick
+        # device_puts fresh inputs (nothing aliases across ticks).
+        # Callers gate this to the TPU backend: the CPU runtime warns
+        # and ignores donation.
+        return jax.jit(jax.vmap(kernel), donate_argnums=(0, 1, 2))
     return jax.jit(jax.vmap(kernel))
 
 
+@functools.lru_cache(maxsize=None)
+def _batched_kernel_sharded(f_max: int, w: int, n_dev: int,
+                            devs_key: tuple):
+    """shard_map form of the vmapped wave ladder for ONE oversized
+    (bucket, width) group: the key axis splits over a ("key",) device
+    mesh and each shard runs its own vmapped while_loop — unlike the
+    GSPMD scatter, a shard whose keys all die early is NOT held in
+    lockstep wave steps until the slowest shard finishes (the host +
+    device + sharded dispatch split ops/closure.py proved for the
+    closure op). Keys are independent, so nothing rides the ICI.
+    ``devs_key`` pins the cache entry to the device set by string
+    identity (the same aliasing rule as closure._closure_sharded_jitted
+    — ``id()`` of device objects is NOT stable)."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from .wgl_mxu import _shard_map
+
+    del devs_key  # cache key only
+    kernel = jax.vmap(functools.partial(_wgl_kernel, f_max=f_max, w=w))
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("key",))
+    shard_map, vma_kw = _shard_map()
+    sharded = shard_map(kernel, mesh=mesh,
+                        in_specs=(P("key"), P("key"), P("key")),
+                        out_specs=P("key"), **vma_kw)
+    return jax.jit(sharded)
+
+
+def group_key(p: Packed) -> tuple:
+    """The (R-bucket, info dims, window width) dispatch-group key: keys
+    sharing it ride one vmapped launch, and the sharded checker service
+    (runner/checker_service.py) uses it as the unit of sticky
+    group→device placement."""
+    return (bucket(p.R), info_dims(p), p.w)
+
+
+class PreparedGroup:
+    """The host half of one bucket-group dispatch: padded + stacked
+    numpy tables for a same-``group_key`` key group. Splitting this off
+    ``_check_bucket_group`` lets the checker service double-buffer —
+    pack tick N+1's groups on the dispatcher thread while tick N's jobs
+    still run on their chips. ``lanes`` is the device-lane count the
+    key axis was padded for (1 for a single committed device, n_dev for
+    the mesh paths)."""
+
+    __slots__ = ("key", "n", "lanes", "k_pad", "stacked", "Rs", "Is")
+
+    def __init__(self, key, n, lanes, k_pad, stacked, Rs, Is):
+        self.key = key
+        self.n = n
+        self.lanes = lanes
+        self.k_pad = k_pad
+        self.stacked = stacked
+        self.Rs = Rs
+        self.Is = Is
+
+
+def prepare_bucket_group(packs: list, idxs: list, r_pad: int,
+                         info: tuple, lanes: int = 1) -> PreparedGroup:
+    """Pad and stack a key group's tables on the host (no jax touched).
+
+    The key axis pads to a power-of-two per-lane count times ``lanes``
+    so jit caches stay warm across varying group sizes (the campaign
+    checker service coalesces packs from many runs per tick, so K
+    varies tick to tick); padding keys have R=0 and their lanes are
+    dropped at decode — verdicts never see the pad."""
+    K = len(idxs)
+    per_lane = 1
+    while per_lane * lanes < K:
+        per_lane *= 2
+    k_pad = per_lane * lanes
+    per_key = [pad_tables(packs[i], r_pad, info) for i in idxs]
+    stacked = {}
+    for name in per_key[0]:
+        arrs = [t[name] for t in per_key]
+        out = np.zeros((k_pad,) + arrs[0].shape, dtype=arrs[0].dtype)
+        for j, a in enumerate(arrs):
+            out[j] = a
+        stacked[name] = out
+    Rs = np.zeros(k_pad, dtype=np.int32)  # padding keys: R=0 -> accepted
+    Is = np.zeros(k_pad, dtype=np.int32)
+    for j, i in enumerate(idxs):
+        Rs[j] = packs[i].R
+        Is[j] = packs[i].I
+    return PreparedGroup((r_pad, info, packs[idxs[0]].w), K, lanes,
+                         k_pad, stacked, Rs, Is)
+
+
 def check_packed_batch(packs: list, f_max: Optional[int] = None,
-                       try_fused: bool = True) -> list:
+                       try_fused: bool = True, device=None,
+                       shard: bool = False, prepared=None,
+                       device_for=None) -> list:
     """Check K per-key packed histories in vmapped kernel launches.
 
     This is the production key-level data-parallel axis (SURVEY §2.3; the
@@ -1942,6 +2038,16 @@ def check_packed_batch(packs: list, f_max: Optional[int] = None,
     climbs the remaining ladder rungs through ``check_packed``; spill is
     deferred (``{"overflow": True}`` result) so the calling checker can
     interpose its cheaper DFS first.
+
+    Placement (ISSUE 15, the sharded checker service): ``device``
+    commits every launch to one chip; ``device_for`` is a per-group
+    callback ``group_key -> device | None`` (the service-down fallback
+    routes through the service's sticky round-robin map with it);
+    ``shard=True`` splits each group's key axis over the whole device
+    mesh with shard_map instead of the GSPMD scatter (one oversized
+    group); ``prepared`` maps group keys to PreparedGroup host tables
+    built ahead by :func:`prepare_bucket_group` (the service's
+    double-buffered packing). All default to the historical behavior.
 
     Returns one result dict per pack, aligned with the input order.
     """
@@ -1959,8 +2065,9 @@ def check_packed_batch(packs: list, f_max: Optional[int] = None,
     # these packs are its leftovers.
     if f_max is None and try_fused:
         from . import wgl_mxu
-        mxu_out = _run_fused(_mxu_broken, "mxu batch",
-                             lambda: wgl_mxu.check_packed_batch_mxu(packs))
+        mxu_out = _run_fused(
+            _mxu_broken, "mxu batch",
+            lambda: wgl_mxu.check_packed_batch_mxu(packs, device=device))
         if mxu_out is not None:
             for i, out in enumerate(mxu_out):
                 if out is not None and not out.get("overflow"):
@@ -1978,64 +2085,96 @@ def check_packed_batch(packs: list, f_max: Optional[int] = None,
             groups.setdefault((bucket(p.R), info_dims(p), p.w),
                               []).append(i)
     for (r_pad, info, w), idxs in groups.items():
-        _check_bucket_group(packs, results, idxs, r_pad, info, w, f_max)
+        dev = device
+        if dev is None and device_for is not None:
+            dev = device_for((r_pad, info, w))
+        prep = None if prepared is None else prepared.get((r_pad, info, w))
+        _check_bucket_group(packs, results, idxs, r_pad, info, w, f_max,
+                            device=dev, shard=shard, prepared=prep)
     return results
 
 
 def _check_bucket_group(packs: list, results: list, idxs: list,
                         r_pad: int, info: tuple, w: int,
-                        f_max: Optional[int]) -> None:
+                        f_max: Optional[int], device=None,
+                        shard: bool = False, prepared=None) -> None:
     """One vmapped launch for a same-bucket key group; results written
-    in place."""
+    in place. ``device`` commits the launch to one chip (the sharded
+    checker service's per-group placement); ``shard=True`` splits the
+    key axis over the device mesh with shard_map (one oversized group);
+    the default keeps the historical behavior — a GSPMD scatter over
+    every visible device when more than one exists. ``prepared`` is an
+    optional :class:`PreparedGroup` built ahead on the host; it is
+    validated against the group and silently rebuilt on any mismatch
+    (e.g. the fused MXU path already claimed part of the group)."""
     import jax
     import jax.numpy as jnp
 
-    if len(idxs) == 1:
+    if len(idxs) == 1 and not shard:
+        # a lone pack rides the rung ladder (early exit beats the
+        # fixed-f batched kernel) — unless the caller asked to shard,
+        # where even one pack pads across the mesh to keep chips warm
         results[idxs[0]] = check_packed(packs[idxs[0]], f_max=f_max,
-                                        spill=False)
+                                        spill=False, device=device)
         return
     if f_max is None:
         f_max = 128
     K = len(idxs)
     devs = jax.devices()
-    n_dev = len(devs)
-    # shard the key axis evenly, padded to a power-of-two per-device
-    # count so jit caches stay warm across varying group sizes (the
-    # campaign checker service coalesces packs from many runs per
-    # tick, so K varies tick to tick; padding keys have R=0 and their
-    # lanes are dropped below — verdicts never see the pad)
-    per_dev = 1
-    while per_dev * n_dev < K:
-        per_dev *= 2
-    k_pad = per_dev * n_dev
-    per_key = [pad_tables(packs[i], r_pad, info) for i in idxs]
-    stacked = {}
-    for name in per_key[0]:
-        arrs = [t[name] for t in per_key]
-        out = np.zeros((k_pad,) + arrs[0].shape, dtype=arrs[0].dtype)
-        for j, a in enumerate(arrs):
-            out[j] = a
-        stacked[name] = out
-    Rs = np.zeros(k_pad, dtype=np.int32)  # padding keys: R=0 -> accepted
-    Is = np.zeros(k_pad, dtype=np.int32)
-    for j, i in enumerate(idxs):
-        Rs[j] = packs[i].R
-        Is[j] = packs[i].I
+    if device is not None:
+        lanes = 1
+    elif shard:
+        # always the FULL mesh: the key axis pads up to the lane count,
+        # so even a lone pack spreads over every chip (and every chip's
+        # executable stays warm for the next single-group tick). The
+        # explicit shard_map kernel is reserved for genuinely oversized
+        # groups; smaller ones ride the same GSPMD scatter as mixed
+        # groups (identical placement, shared compile cache)
+        lanes = len(devs)
+        shard = lanes > 1 and K >= 2 * lanes
+    else:
+        lanes = len(devs)
+    if prepared is not None and not (
+            prepared.n == K and prepared.lanes == lanes
+            and prepared.key == (r_pad, info, w)
+            and all(packs[i].R == int(prepared.Rs[j])
+                    for j, i in enumerate(idxs))):
+        prepared = None
+    if prepared is None:
+        prepared = prepare_bucket_group(packs, idxs, r_pad, info,
+                                        lanes=lanes)
+    stacked, Rs, Is = prepared.stacked, prepared.Rs, prepared.Is
 
-    if n_dev > 1:
+    if device is not None:
+        def put(x):
+            return jax.device_put(x, device)
+        # committed inputs pin the jit executable to this chip; donated
+        # buffers free their memory for the ladder's working set
+        # (TPU-only — the CPU runtime warns and ignores donation)
+        kern = _batched_kernel_jitted(
+            f_max, w,
+            donate=(getattr(device, "platform", "") == "tpu"))
+    elif lanes > 1:
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-        mesh = Mesh(np.array(devs), ("dp",))
+        mesh = Mesh(np.array(devs[:lanes]), ("dp",))
 
         def put(x):
             s = NamedSharding(mesh, P("dp", *([None] * (x.ndim - 1))))
             return jax.device_put(jnp.asarray(x), s)
+        if shard:
+            kern = _batched_kernel_sharded(
+                f_max, w, lanes,
+                tuple(str(d) for d in devs[:lanes]))
+        else:
+            kern = _batched_kernel_jitted(f_max, w)
     else:
         put = jnp.asarray
+        kern = _batched_kernel_jitted(f_max, w)
     tables_dev = {k: put(v) for k, v in stacked.items()}
     tel = telemetry.current()
     with tel.span("wgl.batch-dispatch", keys=K, w=w, f_max=f_max):
-        valid, overflow, waves, peak, _frontier = _batched_kernel_jitted(
-            f_max, w)(tables_dev, put(Rs), put(Is))
+        valid, overflow, waves, peak, _frontier = kern(
+            tables_dev, put(Rs), put(Is))
         valid = np.asarray(valid)
     overflow = np.asarray(overflow)
     waves = np.asarray(waves)
@@ -2050,7 +2189,8 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
             # batch; spill is deferred so the checker can interpose
             # its cheaper DFS on top-rung overflow (see
             # TPULinearizableChecker._overflow)
-            results[i] = check_packed(p, f_max=F_MAX, spill=False)
+            results[i] = check_packed(p, f_max=F_MAX, spill=False,
+                                      device=device)
         else:
             v = bool(valid[j])
             results[i] = {
@@ -2061,15 +2201,17 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
 
 
 def check_packed(p: Packed, f_max: Optional[int] = None,
-                 spill: bool = True) -> dict:
+                 spill: bool = True, device=None) -> dict:
     """Telemetry shell around :func:`_check_packed_impl`: one span per
     dispatch (per-dispatch wall time), plus the routing counters a run's
     results.json surfaces (dispatch count, rung total, peak frontier
-    width across the run)."""
+    width across the run). ``device`` commits the launch to one chip
+    (the checker service's per-group placement)."""
     tel = telemetry.current()
     with tel.span("wgl.check_packed", ops=getattr(p, "R", None),
                   w=getattr(p, "w", None)) as sp:
-        out = _check_packed_impl(p, f_max=f_max, spill=spill)
+        out = _check_packed_impl(p, f_max=f_max, spill=spill,
+                                 device=device)
         sp.set(engine=out.get("engine"), valid=out.get("valid?"),
                rungs=out.get("rungs"), waves=out.get("waves"),
                peak_frontier=out.get("peak-frontier"))
@@ -2082,7 +2224,7 @@ def check_packed(p: Packed, f_max: Optional[int] = None,
 
 
 def _check_packed_impl(p: Packed, f_max: Optional[int] = None,
-                       spill: bool = True) -> dict:
+                       spill: bool = True, device=None) -> dict:
     """Run the kernel on one packed history (host->device->host).
 
     f_max defaults small (tiny sorts, fast waves — healthy frontiers
@@ -2123,7 +2265,8 @@ def _check_packed_impl(p: Packed, f_max: Optional[int] = None,
         # tests/test_wgl_mxu.py
         from . import wgl_mxu
         out = _run_fused(_mxu_broken, "mxu wave",
-                         lambda: wgl_mxu.check_packed_mxu(p))
+                         lambda: wgl_mxu.check_packed_mxu(p,
+                                                          device=device))
         if out is not None and not out.get("overflow"):
             return out
     # f_max (when given) is the STARTING rung; the ladder still
@@ -2142,7 +2285,16 @@ def _check_packed_impl(p: Packed, f_max: Optional[int] = None,
         ladder = [f for f in ladder
                   if f <= F_MAX and f != 256] or [ladder[0]]
     _c_pad, ni, _i_tab = info_dims(p)
-    tables = {k: jnp.asarray(v)
+    if device is not None:
+        import jax
+
+        def _put(x):
+            # committed inputs pin every ladder rung to this chip;
+            # uncommitted scalars follow the committed operands
+            return jax.device_put(x, device)
+    else:
+        _put = jnp.asarray
+    tables = {k: _put(np.asarray(v))
               for k, v in pad_tables(p, bucket(p.R)).items()}
     R_, I_ = jnp.int32(p.R), jnp.int32(p.I)
     peak_all = 1
@@ -2156,8 +2308,8 @@ def _check_packed_impl(p: Packed, f_max: Optional[int] = None,
     v0[0] = NONE_VAL
     valid, overflow, k, peak, frontier = _kernel_resume_jitted(
         ladder[0], p.w)(tables, R_, I_, jnp.int32(0),
-                        jnp.asarray(d0), jnp.asarray(w0),
-                        jnp.asarray(i0), jnp.asarray(v0),
+                        _put(d0), _put(w0),
+                        _put(i0), _put(v0),
                         jnp.int32(1))
     peak_all = max(peak_all, int(peak))
     rungs = 1

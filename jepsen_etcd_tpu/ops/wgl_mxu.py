@@ -991,9 +991,11 @@ def _decode(out: np.ndarray, p: Packed) -> dict:
             "stuck-at-depth": waves}
 
 
-def check_packed_mxu(p: Packed) -> dict | None:
+def check_packed_mxu(p: Packed, device=None) -> dict | None:
     """Run the MXU wave kernel on one packed history; None when
-    unsupported, an overflow-unknown when capacity was exceeded."""
+    unsupported, an overflow-unknown when capacity was exceeded.
+    ``device`` commits the dispatch to one chip (the sharded checker
+    service's per-group placement)."""
     import jax
     import jax.numpy as jnp
 
@@ -1003,9 +1005,14 @@ def check_packed_mxu(p: Packed) -> dict | None:
     r_pad = max(bucket(p.R), TSUB)
     i32, u16 = pack_perop(p, r_pad)
     interpret = jax.default_backend() != "tpu"
+    if device is not None:
+        def _put(x):
+            return jax.device_put(x, device)
+    else:
+        _put = jnp.asarray
     with tel.span("mxu.dispatch", ops=p.R, w=p.w) as sp:
         out = np.asarray(_call_single(r_pad, p.w, interpret)(
-            jnp.asarray(i32), jnp.asarray(u16)))
+            _put(i32), _put(u16)))
         res = _decode(out, p)
         sp.set(valid=res.get("valid?"),
                peak_frontier=res.get("peak-frontier"))
@@ -1013,17 +1020,26 @@ def check_packed_mxu(p: Packed) -> dict | None:
     return res
 
 
-def launch_packed_batch_mxu(packs: list) -> list:
+def launch_packed_batch_mxu(packs: list, device=None) -> list:
     """Stage + asynchronously launch the supported packs, one pallas
     dispatch per (R-bucket, window-width, BATCH_CHUNK) chunk. Returns a
     list of (index_chunk, device_future, pack_chunk) launch records for
     ``collect_packed_batch_mxu``: all launches go out before any
-    readback, so a multi-group batch pays one synchronization total."""
+    readback, so a multi-group batch pays one synchronization total.
+    ``device`` commits every chunk to one chip (single-device geometry
+    — the sharded checker service owns cross-chip placement at the
+    group level, so the fused batch must not scatter over the mesh
+    behind its back)."""
     import jax
     import jax.numpy as jnp
 
     interpret = jax.default_backend() != "tpu"
     tel = telemetry.current()
+    if device is not None:
+        def _put(x):
+            return jax.device_put(x, device)
+    else:
+        _put = jnp.asarray
     groups: dict = {}
     for i, p in enumerate(packs):
         if supported(p):
@@ -1037,15 +1053,19 @@ def launch_packed_batch_mxu(packs: list) -> list:
                 # variants instead of one compile per distinct batch
                 # size; padding keys are all-zero (R=0) rows whose grid
                 # steps die at the first frontier-death check
-                k_pad, n_dev = _batch_geometry(len(chunk))
+                if device is not None:
+                    k_pad = 1
+                    while k_pad < len(chunk):
+                        k_pad *= 2
+                    n_dev = 1
+                else:
+                    k_pad, n_dev = _batch_geometry(len(chunk))
                 i32s, u16s = pack_perop_batch([packs[i] for i in chunk],
                                               r_pad, k_pad)
                 dev = _batch_call_for(k_pad, r_pad, wk, n_dev,
                                       interpret)(
-                    # graftlint: ignore[JAX001] batch launcher: one dispatch per device-sized chunk is its design
-                    jnp.asarray(i32s.reshape(k_pad * r_pad, 4)),
-                    # graftlint: ignore[JAX001] batch launcher: one dispatch per device-sized chunk is its design
-                    jnp.asarray(u16s.reshape(k_pad * r_pad, 12)))
+                    _put(i32s.reshape(k_pad * r_pad, 4)),
+                    _put(u16s.reshape(k_pad * r_pad, 12)))
                 launched.append((chunk, dev,
                                  [packs[i] for i in chunk]))
         sp.set(chunks=len(launched),
@@ -1066,15 +1086,18 @@ def collect_packed_batch_mxu(launched: list, results: list) -> None:
                 results[i] = _decode(out[j], p)
 
 
-def check_packed_batch_mxu(packs: list) -> list | None:
+def check_packed_batch_mxu(packs: list, device=None) -> list | None:
     """Check many packed histories in ONE pallas dispatch per
     (R-bucket, window-width) chunk, all launched before any readback.
     Returns per-pack results aligned with input order; packs the
     kernel can't take (wide window, info ops, id overflow) get None
     entries for the caller's per-key fallback. Returns None outright
-    when NO pack is supported."""
+    when NO pack is supported. ``device`` commits every chunk to one
+    chip (see :func:`launch_packed_batch_mxu`)."""
     if not packs or not any(supported(p) for p in packs):
         return None
     results: list = [None] * len(packs)
-    collect_packed_batch_mxu(launch_packed_batch_mxu(packs), results)
+    collect_packed_batch_mxu(launch_packed_batch_mxu(packs,
+                                                     device=device),
+                             results)
     return results

@@ -206,6 +206,8 @@ class LiveCollector:
                         "groups": attrs.get("groups"),
                         "runs": attrs.get("runs"),
                         "device": attrs.get("device"),
+                        "placement": attrs.get("placement"),
+                        "sharded": attrs.get("sharded"),
                     }
                 dur = rec.get("dur_s")
                 if name in ("wgl.check_packed", "stream.chunk",
@@ -632,7 +634,8 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
         # dispatches-per-(bucket, width, tick) bar
         for cname, value in (service_stats.get("counters") or {}).items():
             tel.counter(cname, value,
-                        mode="max" if cname == "service.batch_occupancy"
+                        mode="max" if cname in ("service.batch_occupancy",
+                                                "service.device_occupancy")
                         else "sum")
     if collector is not None:
         lstats = collector.close()

@@ -1,4 +1,4 @@
-"""Batched TPU checker service: one process owns the device.
+"""Batched TPU checker service: one process owns every device.
 
 The campaign driver (runner/campaign.py) fans runs over a process
 pool; if each run dispatched its own device checks it would pay the
@@ -11,6 +11,20 @@ AF_UNIX socket, and the service coalesces everything pending across
 all connections into one ``wgl.check_packed_batch`` call per tick —
 one device dispatch per (bucket, width) group per tick, no matter how
 many runs contributed keys.
+
+Multi-device dispatch (ISSUE 15): the dispatcher assigns each
+(bucket, width) group to a chip with a STICKY round-robin map
+(``DevicePlacement`` — a group shape always lands on the chip whose
+compiled executable is warm) and hands the per-group launches to
+per-device worker threads, so a v5e-8's eight chips run eight group
+dispatches concurrently instead of queueing one. A tick whose packs
+all share ONE group shape instead shards the batch axis of the wave
+ladder over the whole mesh with shard_map (the host + device + sharded
+split ops/closure.py proved). Host packing is double-buffered: while
+tick N's jobs run on their chips, the dispatcher packs tick N+1's
+tables (``wgl.prepare_bucket_group``), so pack_s and dispatch wall
+overlap instead of serialize; on TPU the packed inputs are donated to
+the launch (PERF.md §6).
 
 Soundness contract: the service runs the exact device-path code the
 in-process checker would (``check_packed_batch`` over deserialized
@@ -44,6 +58,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import queue
 import socket
 import struct
 import tempfile
@@ -122,35 +137,183 @@ class _Request:
         self.trace = trace
 
 
-#: memo for _device_name — mutated in place (idempotent value, so a
-#: racing double-compute is benign and no module global is rebound)
-_device_name_cache: dict = {}
-
-
-def _device_name() -> str:
-    """``platform+id`` of the device this service dispatches on
-    (``tpu0``, ``cpu0``); the attribution key ROADMAP #3's sharded
-    service will carry per shard."""
-    name = _device_name_cache.get("name")
-    if name is None:
+def device_name(d=None) -> str:
+    """``platform+id`` of a device (``tpu0``, ``cpu3``) — the per-shard
+    attribution key the sharded service carries on every counter. With
+    no argument it names the process's default device (device 0), which
+    keeps the historical ``tpu0``/``cpu0`` labels stable for existing
+    dashboards; ``host0`` when jax is unavailable."""
+    if d is None:
         try:
             import jax
             d = jax.devices()[0]
-            name = f"{d.platform}{d.id}"
         except Exception:
-            name = "host0"
-        _device_name_cache["name"] = name
-    return name
+            return "host0"
+    return f"{d.platform}{d.id}"
+
+
+class DevicePlacement:
+    """Sticky round-robin group→device placement.
+
+    The first time a (bucket, width) group shape appears it takes the
+    next chip in round-robin order; every later tick reuses that chip,
+    so the group's compiled executable stays warm exactly where its
+    inputs land (a fresh shape on a fresh chip compiles once — moving
+    shapes between chips would recompile per move). All state lives
+    under one lock: the service dispatcher, the stats reader, and the
+    in-process fallback path (``fallback_device_for``) share instances.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._map: dict = {}
+        self._devices: Optional[list] = None
+        self._next = 0
+
+    def _ensure(self) -> list:
+        # callers hold self._lock
+        if self._devices is None:
+            try:
+                import jax
+                self._devices = list(jax.devices())
+            except Exception:
+                self._devices = []
+        return self._devices
+
+    def devices(self) -> list:
+        """Every visible device (imports jax on first use)."""
+        with self._lock:
+            return list(self._ensure())
+
+    def devices_if_known(self) -> list:
+        """Like ``devices()`` but never imports jax — empty until some
+        assignment forced the device list (safe from stats readers)."""
+        with self._lock:
+            return list(self._devices or [])
+
+    def assign(self, key) -> tuple:
+        """(device index, device) for a group key — sticky round-robin;
+        ``(0, None)`` when no device is visible."""
+        with self._lock:
+            devs = self._ensure()
+            if not devs:
+                return 0, None
+            idx = self._map.get(key)
+            if idx is None:
+                idx = self._next % len(devs)
+                self._next += 1
+                self._map[key] = idx
+            return idx, devs[idx]
+
+    def snapshot(self) -> dict:
+        """JSON-safe ``repr(group_key) -> device name`` map."""
+        with self._lock:
+            devs = self._devices or []
+            return {repr(k): (device_name(devs[i]) if i < len(devs)
+                              else f"dev{i}")
+                    for k, i in self._map.items()}
+
+
+#: per-process sticky placement for in-process fallbacks — the same
+#: group→device policy the service dispatcher runs, so a service-down
+#: fallback lands on the chip a group's executable is (or will be)
+#: warm on instead of re-serializing everything onto device 0
+_process_placement: Optional[DevicePlacement] = None
+_process_placement_lock = threading.Lock()
+
+
+def process_placement() -> DevicePlacement:
+    global _process_placement
+    with _process_placement_lock:
+        if _process_placement is None:
+            _process_placement = DevicePlacement()
+        return _process_placement
+
+
+def fallback_device_for(tel: Optional[Telemetry] = None):
+    """A ``group_key -> device`` callback for
+    ``wgl.check_packed_batch(device_for=...)``: routes a service-down
+    fallback through the process's sticky placement map and counts
+    each placed group under ``service.fallback.<dev>``. Returns None
+    when fewer than two devices are visible — placement is a no-op
+    there, and the historical single-device behavior is already
+    correct."""
+    place = process_placement()
+    if len(place.devices()) < 2:
+        return None
+
+    def device_for(key):
+        _idx, dev = place.assign(key)
+        if tel is not None and dev is not None:
+            tel.counter("service.fallback." + device_name(dev))
+        return dev
+
+    return device_for
+
+
+class _GroupJob:
+    """One group's device dispatch, run on a per-device worker thread.
+    The job owns all its state — the worker only calls ``run()`` and
+    the dispatcher only reads after ``done`` is set — so the Event is
+    the whole synchronization story."""
+
+    __slots__ = ("packs", "key", "device", "dev_names", "shard",
+                 "prepared", "outs", "error", "busy_s", "done")
+
+    def __init__(self, packs, key, device, dev_names, shard, prepared):
+        self.packs = packs
+        self.key = key
+        self.device = device
+        self.dev_names = dev_names
+        self.shard = shard
+        self.prepared = prepared
+        self.outs = None
+        self.error = None
+        self.busy_s = 0.0
+        self.done = threading.Event()
+
+    def run(self) -> None:
+        from ..ops import wgl
+        t0 = time.monotonic()
+        try:
+            prepared = ({self.key: self.prepared}
+                        if self.prepared is not None else None)
+            # module-attribute lookup at call time: tests monkeypatch
+            # wgl.check_packed_batch and the jobs must see it
+            self.outs = wgl.check_packed_batch(
+                self.packs, device=self.device, shard=self.shard,
+                prepared=prepared)
+        except Exception as e:  # degrade, never wedge clients
+            logger.exception("checker service group dispatch failed")
+            self.error = repr(e)
+        finally:
+            self.busy_s = time.monotonic() - t0
+            self.done.set()
+
+
+class _Tick:
+    """One in-flight coalescing tick: its request batch, flattened
+    pack slots, per-pack results, and the group jobs out on the
+    per-device worker queues. Exists so the dispatcher can hold tick
+    N open (jobs running on their chips) while it packs tick N+1."""
+
+    __slots__ = ("batch", "slots", "results", "jobs", "trivial_err",
+                 "t_start", "span", "n_packs", "n_groups", "placement",
+                 "sharded", "lanes", "pack_s")
 
 
 class CheckerService:
     """The device-owning batch scheduler.
 
     Threads: one acceptor, one reader per connection (they only parse
-    and enqueue), and ONE dispatcher that owns every device call —
-    jax state is never touched from two threads. All shared state
-    (pending queue, connection list, stop flag) is mutated under
-    ``_cv`` only.
+    and enqueue), ONE dispatcher that freezes batches, packs host
+    tables, and places groups, and one worker per visible device that
+    runs the placed group dispatches (``_GroupJob.run``). Each chip's
+    launches stay serialized on its own worker — concurrent jax calls
+    only ever target DIFFERENT devices. All shared service state
+    (pending queue, connection list, worker queues, stop flag) is
+    mutated under ``_cv`` only; job state is handed off through the
+    per-job ``done`` event, and the placement map has its own lock.
     """
 
     def __init__(self, path: Optional[str] = None,
@@ -168,6 +331,10 @@ class CheckerService:
         self._threads: list[threading.Thread] = []
         self._stopped = False
         self._listener: Optional[socket.socket] = None
+        #: sticky group→device map; lazy so constructing a service
+        #: (tests, option plumbing) never imports jax
+        self._placement = DevicePlacement()
+        self._work_qs: list[queue.Queue] = []
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "CheckerService":
@@ -228,8 +395,15 @@ class CheckerService:
             pass
 
     def stats(self) -> dict:
-        """The service's telemetry summary (counters + spans)."""
-        return self.tel.summary()
+        """The service's telemetry summary (counters + spans) plus the
+        device roster and sticky placement map. Uses the non-forcing
+        device peek so a stats RPC from a reader thread never
+        initializes jax — empty lists until the first tick ran."""
+        out = self.tel.summary()
+        out["devices"] = [device_name(d)
+                          for d in self._placement.devices_if_known()]
+        out["placement"] = self._placement.snapshot()
+        return out
 
     # -- socket side ---------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -311,84 +485,251 @@ class CheckerService:
 
     # -- device side ---------------------------------------------------------
     def _dispatch_loop(self) -> None:
+        # deep wgl code reaches the recorder via telemetry.current();
+        # the thread-local pin cannot race — other threads never see
+        # it, and each per-device worker pins its own.
+        telemetry.set_thread_current(self.tel)
+        inflight: Optional[_Tick] = None
         while True:
             with self._cv:
                 while not self._pending and not self._stopped:
                     self._cv.wait()
                 if self._stopped and not self._pending:
-                    return
+                    break
             # coalescing window: let concurrently-finishing runs land
             # their submissions before the batch is frozen
             time.sleep(self.tick_s)
             with self._cv:
                 batch, self._pending = self._pending, []
-            if batch:
-                self._run_tick(batch)
+            if not batch:
+                if inflight is not None:
+                    self._finalize_tick(inflight)
+                    inflight = None
+                continue
+            self._ensure_workers()
+            # double buffer: pack tick N+1's host tables WHILE tick
+            # N's jobs are still running on their chips — pack_s and
+            # device wall overlap instead of serialize
+            tick = self._prepare_tick(batch)
+            if inflight is not None:
+                self._finalize_tick(inflight)
+                inflight = None
+            self._submit_tick(tick)
+            with self._cv:
+                more = bool(self._pending) and not self._stopped
+            if more:
+                inflight = tick  # keep packing; finalize next loop
+            else:
+                self._finalize_tick(tick)  # idle: reply promptly
+        if inflight is not None:
+            self._finalize_tick(inflight)
+        with self._cv:
+            qs = list(self._work_qs)
+        for q in qs:
+            q.put(None)  # worker shutdown sentinels
 
-    def _run_tick(self, batch: list[_Request]) -> None:
+    def _ensure_workers(self) -> None:
+        """Lazily start one worker thread per visible device (first
+        batch only — jax is first imported here, on the dispatcher)."""
+        with self._cv:
+            if self._work_qs:
+                return
+        n = max(1, len(self._placement.devices()))
+        qs = [queue.Queue() for _ in range(n)]
+        threads = [threading.Thread(target=self._device_worker,
+                                    args=(q,),
+                                    name=f"checker-svc-dev{i}",
+                                    daemon=True)
+                   for i, q in enumerate(qs)]
+        with self._cv:
+            self._work_qs = qs
+            self._threads += threads
+        for t in threads:
+            t.start()
+
+    def _device_worker(self, q: queue.Queue) -> None:
+        telemetry.set_thread_current(self.tel)
+        while True:
+            job = q.get()
+            if job is None:
+                return
+            job.run()
+
+    def _prepare_tick(self, batch: list[_Request]) -> _Tick:
+        """The host half of a tick: flatten the batch, answer trivial
+        packs inline, group the rest, place each group on its sticky
+        device, and pack the padded host tables (the work that
+        overlaps the previous tick's device wall)."""
         from ..ops import wgl
-        t_start = time.monotonic()
+        tick = _Tick()
+        tick.batch = batch
+        tick.t_start = time.monotonic()
+        tick.span = None
+        tick.trivial_err = None
         all_packs = []
         slots = []  # (request index, offset into its results)
         for ri, req in enumerate(batch):
             for j, p in enumerate(req.packs):
                 all_packs.append(p)
                 slots.append((ri, j))
-        groups = {(wgl.bucket(p.R), wgl.info_dims(p), p.w)
-                  for p in all_packs if p.ok and p.R > 0}
-        runs = sorted({req.trace for req in batch
+        tick.slots = slots
+        tick.n_packs = len(all_packs)
+        tick.results = [None] * len(all_packs)
+        groups: dict = {}
+        trivial = []
+        for i, p in enumerate(all_packs):
+            if p.ok and p.R > 0:
+                groups.setdefault(wgl.group_key(p), []).append(i)
+            else:
+                trivial.append(i)
+        tick.n_groups = len(groups)
+        if trivial:
+            # degenerate packs (rejected windows, zero reads) never
+            # touch a device; answer them on the dispatcher thread
+            try:
+                for i, out in zip(trivial, wgl.check_packed_batch(
+                        [all_packs[i] for i in trivial])):
+                    tick.results[i] = out
+            except Exception as e:
+                logger.exception("checker service trivial check failed")
+                tick.trivial_err = repr(e)
+        devs = self._placement.devices()
+        n_dev = max(1, len(devs))
+        # one group and a whole mesh: spread the batch axis of the
+        # wave ladder itself instead of parking 7 chips — the key axis
+        # pads to the lane count, so a fleet that only ever produces
+        # one (bucket, width) shape still exercises (and warms) every
+        # chip at one launch per tick, even for a lone pack (wgl picks
+        # shard_map for oversized groups, GSPMD scatter for small)
+        only = (next(iter(groups.values()))
+                if len(groups) == 1 else None)
+        tick.sharded = only is not None and n_dev > 1
+        tick.lanes = 1
+        tick.placement = {}
+        tick.jobs = []
+        for key, idxs in groups.items():
+            gpacks = [all_packs[i] for i in idxs]
+            local = list(range(len(gpacks)))
+            if tick.sharded:
+                lanes = n_dev
+                names = [device_name(d) for d in devs[:lanes]]
+                prep = wgl.prepare_bucket_group(gpacks, local, key[0],
+                                                key[1], lanes=lanes)
+                job = _GroupJob(gpacks, key, None, names, True, prep)
+                qi = 0
+                tick.lanes = lanes
+            else:
+                qi, dev = self._placement.assign(key)
+                names = [device_name(dev) if dev is not None
+                         else device_name()]
+                prep = None
+                if len(idxs) > 1:  # K==1 takes the single-pack path
+                    prep = wgl.prepare_bucket_group(gpacks, local,
+                                                    key[0], key[1],
+                                                    lanes=1)
+                job = _GroupJob(gpacks, key, dev, names, False, prep)
+            tick.jobs.append((job, idxs, qi))
+        tick.pack_s = time.monotonic() - tick.t_start
+        return tick
+
+    def _submit_tick(self, tick: _Tick) -> None:
+        """Open the tick span and hand every group job to its device's
+        worker queue (each chip's launches stay serialized on its own
+        worker)."""
+        runs = sorted({req.trace for req in tick.batch
                        if req.trace is not None})
-        dev = _device_name()
-        # the device work runs under the SERVICE's telemetry (deep
-        # wgl code reaches the recorder via telemetry.current()).
-        # Pin it to THIS thread only: a process-global swap (the old
-        # set_current/restore pair) had a window where a concurrent
-        # in-process checker thread recorded into the service stream —
-        # and restored a stale recorder over a newer one. The
-        # thread-local pin cannot race: other threads never see it.
-        telemetry.set_thread_current(self.tel)
-        try:
-            with self.tel.span("service.tick", packs=len(all_packs),
-                               requests=len(batch),
-                               groups=len(groups),
-                               runs=runs, device=dev) as sp:
-                try:
-                    outs = wgl.check_packed_batch(all_packs)
-                    err = None
-                except Exception as e:  # degrade, never wedge clients
-                    logger.exception("checker service tick failed")
-                    outs, err = None, repr(e)
-                sp.set(error=err)
-        finally:
-            telemetry.set_thread_current(None)
-        busy = time.monotonic() - t_start
+        dev_names = sorted({nm for job, _i, _q in tick.jobs
+                            for nm in job.dev_names})
+        dev_attr = (dev_names[0] if len(dev_names) == 1
+                    else f"{len(dev_names)} devices" if dev_names
+                    else device_name())
+        tick.span = self.tel.span(
+            "service.tick", packs=tick.n_packs,
+            requests=len(tick.batch), groups=tick.n_groups,
+            runs=runs, device=dev_attr, sharded=bool(tick.sharded))
+        tick.span.__enter__()
+        with self._cv:
+            qs = list(self._work_qs)
+        for job, _idxs, qi in tick.jobs:
+            qs[qi % len(qs)].put(job)
+
+    def _finalize_tick(self, tick: _Tick) -> None:
+        """Join the tick's jobs, fold their telemetry (the per-device
+        ledger), and answer every request."""
+        errors = []
+        if tick.trivial_err:
+            errors.append(tick.trivial_err)
+        busy_by_dev: dict[str, float] = {}
+        dispatches: dict[str, int] = {}
+        for job, idxs, _qi in tick.jobs:
+            job.done.wait(timeout=600)
+            if not job.done.is_set():
+                errors.append(f"group {job.key!r} dispatch timed out")
+                continue
+            if job.error is not None:
+                errors.append(job.error)
+            elif job.outs is not None:
+                for i, out in zip(idxs, job.outs):
+                    tick.results[i] = out
+            # fan-counted: a sharded job burns EVERY lane chip for its
+            # wall, a placed job exactly one
+            for nm in job.dev_names:
+                busy_by_dev[nm] = busy_by_dev.get(nm, 0.0) + job.busy_s
+                dispatches[nm] = dispatches.get(nm, 0) + 1
+        err = "; ".join(errors) if errors else None
+        tick.placement = dict(dispatches)
+        tick.span.set(error=err, placement=dict(dispatches))
+        tick.span.__exit__(None, None, None)
+        # per-device ledger (the shipped==submitted identity of
+        # `tel --ledger`, extended per chip): every group this tick
+        # dispatched exactly once, plus one extra lane-dispatch per
+        # extra chip of the sharded job
+        fanout = sum(len(job.dev_names) - 1
+                     for job, _i, _q in tick.jobs)
+        placed = sum(dispatches.values())
+        assert placed == len(tick.jobs) + fanout, \
+            (placed, len(tick.jobs), fanout)
         self.tel.counter("service.ticks")
-        self.tel.counter("service.group_ticks", len(groups))
+        self.tel.counter("service.group_ticks", tick.n_groups)
+        # explicit ledger, not a re-scan: packs in minus one dispatch
+        # per group IS the number of device calls coalescing saved
         self.tel.counter("service.coalesced",
-                         sum(1 for _ in all_packs) - len(groups))
-        self.tel.counter("service.batch_occupancy", len(all_packs),
+                         tick.n_packs - tick.n_groups)
+        self.tel.counter("service.batch_occupancy", tick.n_packs,
                          mode="max")
-        self.tel.counter("service.device_busy_s." + dev,
-                         round(busy, 6))
+        self.tel.counter("service.pack_s", round(tick.pack_s, 6))
+        for nm in sorted(dispatches):
+            self.tel.counter("service.device_dispatches." + nm,
+                             dispatches[nm])
+            self.tel.counter("service.device_busy_s." + nm,
+                             round(busy_by_dev[nm], 6))
+        if dispatches:
+            self.tel.counter("service.device_occupancy",
+                             len(dispatches), mode="max")
+        if tick.sharded:
+            self.tel.counter("service.sharded_ticks")
+            self.tel.counter("service.shard_fanout", fanout)
         # each request's wait is rounded ONCE and used everywhere —
         # the summed counter, the hist, and the per-request reply — so
         # per-run attribution re-sums to the service total exactly
-        waits = [round(t_start - req.t_arrive, 6) for req in batch]
+        waits = [round(tick.t_start - req.t_arrive, 6)
+                 for req in tick.batch]
         self.tel.counter("service.queue_wait_s", round(sum(waits), 6))
         for w in waits:
             self.tel.hist("service.queue_wait_s", w)
         results_by_req: dict[int, list] = {
-            ri: [None] * len(req.packs) for ri, req in enumerate(batch)}
-        if outs is not None:
-            for (ri, j), out in zip(slots, outs):
+            ri: [None] * len(req.packs)
+            for ri, req in enumerate(tick.batch)}
+        if err is None:
+            for (ri, j), out in zip(tick.slots, tick.results):
                 out = dict(out)
                 # frozen-frontier device arrays cannot cross the
                 # socket; the runner's overflow path re-runs the spill
                 # locally (bit-identical verdict, PR 5 contract)
                 out.pop("_resume", None)
                 results_by_req[ri][j] = _plain(out)
-        for ri, req in enumerate(batch):
-            if outs is None:
+        for ri, req in enumerate(tick.batch):
+            if err is not None:
                 payload = {"id": req.req_id, "error": err,
                            "queue_wait_s": waits[ri]}
             else:

@@ -135,10 +135,31 @@ REGISTRY = {
         "service.queue_wait_s",   # total submit->dispatch wait
         "service.device_busy_s.*",  # per-device busy wall attributed
                                   # by dispatch (dev = platform+id; one
-                                  # series per chip when ROADMAP #3
-                                  # shards the service)
+                                  # series per chip — fan-counted for a
+                                  # sharded tick, every lane chip burns
+                                  # the job's wall)
+        "service.device_dispatches.*",  # group dispatches per chip
+                                  # (fan-counted); ledger identity:
+                                  # Σ over chips == service.group_ticks
+                                  # + service.shard_fanout
+        "service.device_occupancy",  # max distinct chips busy in one
+                                  # tick (mode=max)
+        "service.sharded_ticks",  # ticks whose single group spread its
+                                  # batch axis over the whole mesh
+                                  # (shard_map when oversized, GSPMD
+                                  # scatter otherwise)
+        "service.shard_fanout",   # extra lane-dispatches sharded ticks
+                                  # added beyond one-per-group (Σ of
+                                  # lanes-1), balancing the per-device
+                                  # dispatch ledger
+        "service.pack_s",         # host packing wall per tick (the
+                                  # half double-buffering overlaps with
+                                  # the previous tick's device wall)
         "service.fallback",       # runner-side degradations to
                                   # in-process checking
+        "service.fallback.*",     # fallback groups placed per chip by
+                                  # fallback_device_for (the service's
+                                  # sticky map honored in-process)
         "service.checks",         # runner-side: service round-trips
                                   # that returned verdicts
         "service.shipped",        # runner-side packs shipped; summed

@@ -190,6 +190,7 @@ def _campaign_rows(store_base: str) -> list[dict]:
                 "submitted": sctr.get("service.submitted"),
                 "group_ticks": sctr.get("service.group_ticks"),
                 "occupancy": sctr.get("service.batch_occupancy"),
+                "chips": _chip_util(sctr),
                 "fallbacks": sum(int(r.get("service_fallbacks") or 0)
                                  for r in done),
                 # campaign-wide merged-histogram percentiles
@@ -200,6 +201,31 @@ def _campaign_rows(store_base: str) -> list[dict]:
             })
     rows.sort(key=lambda r: r["mtime"])
     return rows
+
+
+def _chip_util(sctr: dict) -> dict | None:
+    """Per-chip utilization summary from a campaign's folded service
+    counters (the sharded dispatcher's ledger): group dispatches and
+    busy wall per device, the max/min dispatch balance ratio, and peak
+    per-tick device occupancy. None for single-device/legacy
+    campaigns, which recorded no per-device dispatch series."""
+    pfx_d = "service.device_dispatches."
+    pfx_b = "service.device_busy_s."
+    disp = {k[len(pfx_d):]: int(v or 0) for k, v in sctr.items()
+            if k.startswith(pfx_d)}
+    if not disp:
+        return None
+    busy = {k[len(pfx_b):]: float(v or 0.0) for k, v in sctr.items()
+            if k.startswith(pfx_b)}
+    lo = min(disp.values())
+    return {
+        "devices": len(disp),
+        "dispatches": disp,
+        "busy_s": busy,
+        "balance": (max(disp.values()) / lo) if lo else None,
+        "occupancy": sctr.get("service.device_occupancy"),
+        "sharded_ticks": sctr.get("service.sharded_ticks"),
+    }
 
 
 def _fmt_s(v) -> str:
@@ -364,7 +390,8 @@ def aggregate_html(store_base: str) -> str:
             "<th>gen ops/s</th><th>batched gen ops/s</th>"
             "<th>check wall</th>"
             "<th>p95 gen/check/queue</th><th>net</th>"
-            "<th>dispatches</th><th>amortization</th></tr>")
+            "<th>dispatches</th><th>amortization</th>"
+            "<th>chips</th></tr>")
         for c in camps:
             when = time.strftime("%Y-%m-%d %H:%M",
                                  time.localtime(c["mtime"]))
@@ -399,6 +426,25 @@ def aggregate_html(store_base: str) -> str:
                               f"({c['fallbacks']} fallbacks)</span>")
             else:
                 amort = "<span class='dim'>per-run checking</span>"
+            chips = c.get("chips")
+            if chips:
+                bal = chips.get("balance")
+                bal_s = (f"{bal:.1f}&times;"
+                         if isinstance(bal, (int, float)) else "&infin;")
+                title = ", ".join(
+                    f"{d}: {n} dispatches"
+                    f" ({_fmt_s(chips['busy_s'].get(d))} busy)"
+                    for d, n in sorted(chips["dispatches"].items()))
+                occ = chips.get("occupancy")
+                sh = chips.get("sharded_ticks")
+                chips_td = (
+                    f"<td title='{html.escape(title)}'>"
+                    f"{chips['devices']} chips, "
+                    f"occ&nbsp;{occ if occ is not None else '?'}, "
+                    f"balance&nbsp;{bal_s}"
+                    + (f", {sh} sharded" if sh else "") + "</td>")
+            else:
+                chips_td = "<td class='dim'>—</td>"
             out.append(
                 f'<tr><td><a href="/{quote(c["dir"])}/?files">'
                 f'{html.escape(c["dir"])}</a></td>'
@@ -407,7 +453,8 @@ def aggregate_html(store_base: str) -> str:
                 f"<td>{_badge(c['valid?'])}</td>"
                 f"<td>{c['wall_s']}s</td>{rate_td}{gb_td}"
                 f"<td>{c['check_s']:.2f}s</td>{p_td}{net_td}"
-                f"<td>{c['dispatches']}</td><td>{amort}</td></tr>")
+                f"<td>{c['dispatches']}</td><td>{amort}</td>"
+                f"{chips_td}</tr>")
         out.append("</table>")
 
     # -- failure dedupe by verdict signature ---------------------------------
@@ -713,7 +760,11 @@ def live_html() -> str:
             "' ticks · last: '+(s.packs||0)+' packs from '+"
             "(s.requests||0)+' requests in '+(s.groups||0)+"
             "' groups on <code>'+(s.device||'?')+'</code>'+"
-            "(s.runs?' · runs '+s.runs.join(', '):'')+'</p>';\n"
+            "(s.runs?' · runs '+s.runs.join(', '):'')+"
+            "(s.placement?'<br>chips: '+Object.entries(s.placement)"
+            ".sort().map(([d,n])=>'<code>'+d+'</code>&times;'+n)"
+            ".join(' · ')+(s.sharded?' · <b>sharded</b>':''):'')+"
+            "'</p>';\n"
             " const hists=Object.entries(d.hists||{});\n"
             " if(hists.length){h+='<h2>Distributions</h2><table>"
             "<tr><th>hist</th><th>n</th><th>p50</th><th>p95</th>"

@@ -12,6 +12,19 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# One persistent XLA compilation cache shared by the suite AND every
+# spawned child (campaign pool workers, bench/CLI/service subprocesses
+# inherit it through the environment): children stop recompiling
+# kernels some other process already built, which is most of their
+# startup on a small CI host.  jax picks both settings up from the
+# environment at backend init; correctness is unaffected — the cache
+# key covers the HLO, the flags, and the jax version.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/jepsen-etcd-tpu-xla-cache")
+# only cache compiles worth sharing — the differential fuzz tests emit
+# hundreds of sub-100ms single-shape compiles nothing ever reuses, and
+# writing those costs more than they save
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.25")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

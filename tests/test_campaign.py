@@ -238,6 +238,19 @@ def test_campaign_coalescing_50_runs(tmp_path):
     assert sum(r["service_fallbacks"] for r in rows) == 0
     assert sum(r["service_shipped"] for r in rows) == submitted
 
+    # -- multi-device placement ledger (8 fake chips via conftest) -------
+    # every chip works, no chip hoards (single-group ticks shard the
+    # batch axis over the full mesh), and the shipped==submitted
+    # identity extends per device: Σ per-device dispatches balances
+    # group ticks plus the sharded fan-out exactly
+    disp = {k.rsplit(".", 1)[1]: v for k, v in ctr.items()
+            if k.startswith("service.device_dispatches.")}
+    assert set(disp) == {f"cpu{i}" for i in range(8)}, disp
+    assert max(disp.values()) <= 2 * min(disp.values()), disp
+    assert sum(disp.values()) == (group_ticks
+                                  + ctr.get("service.shard_fanout", 0)), ctr
+    assert ctr.get("service.device_occupancy") == 8, ctr
+
     # -- verdict bit-identity vs in-process re-check ---------------------
     for r in rows:
         stored = json.load(

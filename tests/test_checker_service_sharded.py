@@ -1,0 +1,161 @@
+"""Multi-device checker service (runner/checker_service.py): sticky
+round-robin placement, per-device counter ledgers, single-group
+shard_map dispatch, and verdict bit-identity across device counts.
+
+The whole suite runs under conftest's forced 8-device CPU mesh, so
+placement decisions are real: `jax.devices()` has eight chips and the
+service must spread distinct (bucket, width) group shapes across them
+while keeping each shape pinned to one chip (warm executables never
+migrate).  The subprocess test re-runs the canonical 12-pack fuzz from
+tests/test_checker_service.py under forced 8-device and 1-device
+meshes and diffs the verdict projections — sharding must never change
+a verdict.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+from jepsen_etcd_tpu.core.history import History
+from jepsen_etcd_tpu.ops import wgl
+from jepsen_etcd_tpu.runner import checker_service as svc_mod
+
+from test_wgl import gen_history
+from test_checker_service import make_packs, view, service  # noqa: F401
+
+import jax
+
+_N_DEV = len(jax.devices())
+
+
+def _one_shape_packs(seed, n):
+    """n packs sharing ONE group key (same bucket/info/width), so a
+    single-request tick sees exactly one oversized group — the
+    shard_map trigger."""
+    rng = random.Random(seed)
+    packs = []
+    key = None
+    while len(packs) < n:
+        h = History(gen_history(rng, n_procs=3, n_ops=12,
+                                info_rate=0.0))
+        p = wgl.pack_register_history(h)
+        if not (p.ok and p.R > 0):
+            continue
+        if key is None:
+            key = wgl.group_key(p)
+        if wgl.group_key(p) == key:
+            packs.append(p)
+    return packs
+
+
+def test_device_name_is_explicit_per_device():
+    assert svc_mod.device_name() == "cpu0"
+    devs = jax.devices()
+    names = [svc_mod.device_name(d) for d in devs]
+    assert names == [f"cpu{d.id}" for d in devs]
+    assert len(set(names)) == _N_DEV
+
+
+def test_placement_round_robin_and_sticky():
+    """Eight distinct group shapes land on eight distinct chips, and
+    re-asking for any shape returns the original assignment."""
+    assert _N_DEV == 8, "conftest forces an 8-device CPU mesh"
+    pl = svc_mod.DevicePlacement()
+    keys = [(16 * (1 << i), (0, 0, 0), 32) for i in range(8)]
+    first = {k: pl.assign(k) for k in keys}
+    assert {idx for idx, _ in first.values()} == set(range(8))
+    assert all(d is not None for _, d in first.values())
+    # sticky: a second pass (any order) changes nothing
+    for k in reversed(keys):
+        assert pl.assign(k) == first[k]
+    snap = pl.snapshot()
+    assert len(snap) == 8
+    assert set(snap.values()) == {f"cpu{i}" for i in range(8)}
+
+
+def test_groups_spread_and_per_device_ledger(service):  # noqa: F811
+    """Mixed-shape fuzz through a live service: distinct group shapes
+    spread round-robin over distinct chips, and the per-device
+    dispatch counters sum exactly to the tick totals."""
+    # same seeds/params as test_checker_service.py's fuzz so the
+    # group shapes (and their compiled executables) are already warm
+    packs = (make_packs(11, 5, info_rate=0.15)
+             + make_packs(12, 3, corrupt=True))
+    want = [view(o) for o in wgl.check_packed_batch(list(packs))]
+    client = svc_mod.CheckerClient(service.path)
+    outs = client.check(packs)
+    assert outs is not None
+    assert [view(o) for o in outs] == want
+    st = service.stats()
+    assert st["devices"] == [f"cpu{i}" for i in range(_N_DEV)]
+    place = st["placement"]
+    n_groups = len({wgl.group_key(p) for p in packs})
+    assert len(place) == n_groups
+    assert len(set(place.values())) == min(n_groups, _N_DEV)
+    ctr = st["counters"]
+    disp = {k: v for k, v in ctr.items()
+            if k.startswith("service.device_dispatches.")}
+    assert set(disp) <= {f"service.device_dispatches.cpu{i}"
+                        for i in range(_N_DEV)}
+    assert sum(disp.values()) == (ctr["service.group_ticks"]
+                                  + ctr.get("service.shard_fanout", 0))
+    assert ctr.get("service.device_occupancy", 0) == min(n_groups,
+                                                         _N_DEV)
+    client.close()
+
+
+def test_single_oversized_group_shards_across_all_devices(
+        service):  # noqa: F811
+    """One group of 2*n_dev packs in a tick takes the shard_map path:
+    the batch axis spreads over EVERY chip, the fan-out is ledgered
+    per device, and verdicts stay bit-identical to local checking."""
+    packs = _one_shape_packs(31, 2 * _N_DEV)
+    want = [view(o) for o in wgl.check_packed_batch(list(packs))]
+    client = svc_mod.CheckerClient(service.path)
+    outs = client.check(packs)
+    assert outs is not None
+    assert [view(o) for o in outs] == want
+    ctr = service.stats()["counters"]
+    assert ctr.get("service.sharded_ticks", 0) >= 1, ctr
+    disp = {k: v for k, v in ctr.items()
+            if k.startswith("service.device_dispatches.")}
+    assert set(disp) == {f"service.device_dispatches.cpu{i}"
+                         for i in range(_N_DEV)}, disp
+    assert sum(disp.values()) == (ctr["service.group_ticks"]
+                                  + ctr["service.shard_fanout"]), ctr
+    client.close()
+
+
+def test_verdicts_identical_across_device_counts(tmp_path):
+    """The satellite's subprocess bar: the same 12-pack fuzz through
+    an 8-device service and a 1-device service (each under its own
+    forced XLA device count) yields bit-identical verdict
+    projections.  Children also self-assert round-robin spread,
+    sticky reuse, and the per-device ledger (see
+    sharded_service_child.py).  Both children run concurrently."""
+    child = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "sharded_service_child.py")
+    repo = os.path.dirname(os.path.dirname(child))
+
+    def spawn(n_dev):
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = \
+            f"--xla_force_host_platform_device_count={n_dev}"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = repo + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        env["TMPDIR"] = str(tmp_path)
+        return subprocess.Popen(
+            [sys.executable, child, str(n_dev)], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+    procs = {n: spawn(n) for n in (8, 1)}
+    outs = {}
+    for n, proc in procs.items():
+        stdout, stderr = proc.communicate(timeout=540)
+        assert proc.returncode == 0, (n, stderr[-4000:])
+        outs[n] = json.loads(stdout.strip().splitlines()[-1])
+    assert len(outs[8]) == 12
+    assert outs[8] == outs[1]
