@@ -181,6 +181,14 @@ def build_parser() -> argparse.ArgumentParser:
                            "--soak) fall back to epoch-v1, and every "
                            "campaign.json row records the epoch that "
                            "actually produced it")
+    camp.add_argument("--hosts", type=int, default=0,
+                      help="multi-host fan-out: spawn N worker-agent "
+                           "processes (host1..hostN) that pull runs "
+                           "over loopback TCP and ship device checks "
+                           "to the campaign's TCP checker service "
+                           "with a campaign-minted auth token; "
+                           "replaces --pool for the non-batched "
+                           "specs (0 = local process pool)")
     camp.add_argument("--force-kernel", action="store_true",
                       help="disable the native-DFS size cutoff so "
                            "every key is device-bound (coalescing "
@@ -199,6 +207,33 @@ def build_parser() -> argparse.ArgumentParser:
                          "path, printed on stdout)")
     cs.add_argument("--tick", type=float, default=0.05,
                     help="coalescing window seconds")
+    cs.add_argument("--tcp", nargs="?", const=True, default=None,
+                    metavar="[HOST:]PORT",
+                    help="also listen on TCP for multi-host clients "
+                         "(bare --tcp: loopback ephemeral port, "
+                         "printed on stdout); pair with --token or "
+                         "JEPSEN_ETCD_TPU_SERVICE_TOKEN so only the "
+                         "fleet can submit")
+    cs.add_argument("--token", default=None,
+                    help="shared-secret auth token TCP clients must "
+                         "present (default: env "
+                         "JEPSEN_ETCD_TPU_SERVICE_TOKEN; unset = "
+                         "unauthenticated)")
+    wa = sub.add_parser("worker-agent",
+                        help="one generator-host agent: registers "
+                             "with a campaign's HostAgentPool over "
+                             "TCP, pulls run specs, ships device "
+                             "checks to the fleet's checker service, "
+                             "returns summary rows (spawned by "
+                             "campaign --hosts; rarely run by hand)")
+    wa.add_argument("--connect", required=True,
+                    help="the pool endpoint (tcp://HOST:PORT)")
+    wa.add_argument("--host", required=True,
+                    help="this agent's host name (row + ledger "
+                         "attribution)")
+    wa.add_argument("--token", default=None,
+                    help="pool auth token (default: env "
+                         "JEPSEN_ETCD_TPU_SERVICE_TOKEN)")
     srv = sub.add_parser("serve", help="serve the store dir over HTTP "
                                        "(etcd.clj:250-252)")
     srv.add_argument("--store", default="store")
@@ -368,11 +403,17 @@ def main(argv=None) -> int:
     # kernel-running commands only: initializes the jax backend
     from .ops.common import enable_compile_cache
     enable_compile_cache()
+    if args.command == "worker-agent":
+        from .runner.host_agent import agent_main
+        return agent_main(args.connect, args.host, token=args.token)
     if args.command == "checker-service":
         import time as _time
         from .runner.checker_service import CheckerService
-        svc = CheckerService(path=args.socket, tick_s=args.tick).start()
-        print(json.dumps({"checker-service": svc.path}), flush=True)
+        svc = CheckerService(path=args.socket, tick_s=args.tick,
+                             tcp=args.tcp,
+                             auth_token=args.token).start()
+        print(json.dumps({"checker-service": svc.path,
+                          "tcp": svc.tcp_endpoint}), flush=True)
         try:
             while True:
                 _time.sleep(3600)
@@ -406,6 +447,7 @@ def main(argv=None) -> int:
             service_tick_s=args.service_tick,
             store_base=args.store, name=args.campaign_name,
             live=not args.no_live,
+            hosts=args.hosts or None,
             on_row=_print_row)
         svc_counters = ((out.get("service") or {}).get("counters")
                         or {})

@@ -69,12 +69,25 @@ def default_opts() -> dict:
                                         # on the proxy plane
         "version": "sim-3.5.6",         # etcd.clj:206-207 (pinned: the sim
                                         # has exactly one "binary")
-        "checker_service": None,        # AF_UNIX socket of a campaign
-                                        # checker service
+        "checker_service": None,        # AF_UNIX socket path or
+                                        # tcp://HOST:PORT endpoint of a
+                                        # campaign checker service
                                         # (runner/checker_service.py);
                                         # None = check in-process. Env
                                         # JEPSEN_ETCD_TPU_CHECKER_SERVICE
                                         # is the fallback source.
+        "checker_service_token": None,  # shared-secret auth token for
+                                        # a TCP checker service (env
+                                        # JEPSEN_ETCD_TPU_SERVICE_TOKEN
+                                        # is the fallback source)
+        "host_id": None,                # this run's generator-host
+                                        # name: stamps the JET-HOST
+                                        # preamble + the service's
+                                        # service.host_submitted.*
+                                        # ledger (campaign --hosts
+                                        # sets it per agent; env
+                                        # JEPSEN_ETCD_TPU_HOST is the
+                                        # fallback source)
         "force_kernel": False,          # disable the native-DFS size
                                         # cutoff so every key is
                                         # device-bound (campaign

@@ -60,6 +60,13 @@ DET_WALLCLOCK_ALLOW = (
                                   # returns are pure functions of the
                                   # shipped packs (THR still applies
                                   # to its reader/dispatcher threads)
+    "runner/transport.py",        # framed-socket plumbing (connect
+                                  # timeouts, preambles): pure
+                                  # transport, never verdict input
+    "runner/host_agent.py",       # worker-agent supervision: spawn/
+                                  # heartbeat/requeue timing (THR
+                                  # still applies to its drive and
+                                  # beat threads)
     "db/local.py",
     "db/fake_etcd.py",
     "net/*",            # userspace proxy plane: socket splice loops

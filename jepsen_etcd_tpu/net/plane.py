@@ -77,6 +77,18 @@ class NetPlane:
         telemetry.current().counter("net.links", 1)
         return proxy.port
 
+    def front_service(self, target_port: int, node: str = "svc",
+                      target_host: str = "127.0.0.1") -> str:
+        """Raise a proxy in front of a checker-service TCP port and
+        return the endpoint clients should dial (``tcp://...``).
+        Service legs ride ``kind="peer"`` so partitions — e.g.
+        ``partition_pairs({frozenset((host, node))})`` — sever the
+        fleet's own control traffic with SUT semantics; attribution
+        comes from the client's ``JET-HOST`` preamble."""
+        port = self.front(node, "peer", target_port,
+                          target_host=target_host)
+        return f"tcp://127.0.0.1:{port}"
+
     def register_member_ids(self, mapping: dict[str, str]) -> None:
         """Install real-etcd member-id-hex -> node-name attribution
         (X-Server-From values are member ids, only known post-setup)."""

@@ -32,11 +32,14 @@ first bytes of ``kind="peer"`` connections and resolved by the plane:
 the fake-etcd prober leads with a ``FAKE-ETCD-PEER <name>\\n``
 preamble; real etcd's rafthttp requests carry an ``X-Server-From:
 <member-id-hex>`` header the plane maps to a node name after setup
-(member ids are only known once the real cluster has formed).
-Sniffed bytes are always forwarded (subject to the rules) — the sniff
-peeks, it never consumes. Unattributable peer connections get
-``src=None`` and are never directionally dropped; ``kind="client"``
-connections are attributed ``src="client"`` with no sniff.
+(member ids are only known once the real cluster has formed); and
+checker-service TCP clients lead with ``JET-HOST <name>\\n``
+(runner/transport.py), so the fleet's own control traffic partitions
+exactly like SUT peer traffic. Sniffed bytes are always forwarded
+(subject to the rules) — the sniff peeks, it never consumes.
+Unattributable peer connections get ``src=None`` and are never
+directionally dropped; ``kind="client"`` connections are attributed
+``src="client"`` with no sniff.
 
 Wall-clock and sleeps here are transport I/O, never verdict input
 (net/* is DET-allowlisted in lint/policy.py); every shared attribute a
@@ -65,6 +68,10 @@ PEER_PREAMBLE = b"FAKE-ETCD-PEER "
 #: real etcd rafthttp sender attribution header (lowercase for the
 #: case-insensitive scan)
 SERVER_FROM = b"x-server-from:"
+
+#: checker-service host preamble (runner/transport.py PREAMBLE): the
+#: generator host announces itself before its first frame
+SVC_PREAMBLE = b"JET-HOST "
 
 _UNDECIDED = object()
 
@@ -214,15 +221,18 @@ class LinkProxy:
     def _attribute(self, buf: bytes):
         """``_UNDECIDED`` (need more bytes), a node name, or None
         (unattributable — pass through undropped)."""
-        head = buf[:len(PEER_PREAMBLE)]
-        if PEER_PREAMBLE.startswith(head):
-            # fake-etcd prober preamble (or a prefix of one)
-            if not buf.startswith(PEER_PREAMBLE):
+        for preamble in (PEER_PREAMBLE, SVC_PREAMBLE):
+            head = buf[:len(preamble)]
+            if not preamble.startswith(head):
+                continue
+            # a line preamble (fake-etcd prober or checker-service
+            # host announcement) — or a prefix of one
+            if not buf.startswith(preamble):
                 return _UNDECIDED
             nl = buf.find(b"\n")
             if nl < 0:
                 return _UNDECIDED if len(buf) < 256 else None
-            return buf[len(PEER_PREAMBLE):nl].decode(
+            return buf[len(preamble):nl].decode(
                 "utf-8", "replace").strip() or None
         # HTTP request (real etcd rafthttp): scan the header block
         lower = buf.lower()

@@ -616,22 +616,52 @@ def serialize_packed(p: Packed) -> bytes:
 
 def deserialize_packed(buf: bytes) -> Packed:
     """Inverse of serialize_packed. The frame tables stay lazy; any
-    consumer that needs them calls ensure_frames (pad_tables does)."""
+    consumer that needs them calls ensure_frames (pad_tables does).
+
+    The manifest is validated against the actual payload BEFORE any
+    array is materialized: field names must be real (non-frame) Packed
+    fields — a hostile header cannot setattr arbitrary attributes —
+    shapes must be non-negative, and the manifest's summed byte length
+    must equal the payload exactly. The service answers a ValueError
+    from here with a structured error reply and keeps the connection
+    (a malformed request is not a dead peer)."""
+    import dataclasses
     import json as _json
     nl = buf.index(b"\n")
     head = _json.loads(buf[:nl].decode())
     if head.get("v") != 1:
         raise ValueError(f"unknown Packed wire version {head.get('v')}")
-    p = Packed(ok=False)
-    for name, v in head["scalars"].items():
-        setattr(p, name, v)
+    wire_fields = {f.name for f in dataclasses.fields(Packed)} \
+        - FRAME_FIELDS
+    scalars = head.get("scalars")
+    arrays = head.get("arrays")
+    if not isinstance(scalars, dict) or not isinstance(arrays, list):
+        raise ValueError("malformed Packed header")
     off = nl + 1
-    for name, dtype, shape in head["arrays"]:
+    manifest = []
+    for entry in arrays:
+        name, dtype, shape = entry
+        if name not in wire_fields:
+            raise ValueError(f"unknown Packed field {name!r}")
+        if not isinstance(shape, list) \
+                or any(not isinstance(d, int) or d < 0 for d in shape):
+            raise ValueError(f"bad shape for {name!r}: {shape!r}")
         dt = np.dtype(dtype)
         n = int(np.prod(shape, dtype=np.int64)) if shape else 1
-        a = np.frombuffer(buf, dtype=dt, count=n,
-                          offset=off).reshape(shape).copy()
+        manifest.append((name, dt, shape, n, off))
         off += n * dt.itemsize
+    if off != len(buf):
+        raise ValueError(
+            f"Packed payload length mismatch: manifest claims "
+            f"{off - nl - 1} bytes, got {len(buf) - nl - 1}")
+    p = Packed(ok=False)
+    for name, v in scalars.items():
+        if name not in wire_fields:
+            raise ValueError(f"unknown Packed field {name!r}")
+        setattr(p, name, v)
+    for name, dt, shape, n, at in manifest:
+        a = np.frombuffer(buf, dtype=dt, count=n,
+                          offset=at).reshape(shape).copy()
         setattr(p, name, a)
     return p
 

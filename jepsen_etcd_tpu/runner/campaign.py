@@ -27,6 +27,7 @@ reads these for the perf-trends-across-campaigns section.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -243,6 +244,8 @@ class LiveCollector:
             st["status"] = row.get("status")
             st["valid"] = row.get("valid")
             st["index"] = row.get("index")
+            if row.get("host") is not None:
+                st["host"] = row.get("host")
         self._snapshot()
 
     def _snapshot(self, done: bool = False) -> None:
@@ -495,7 +498,7 @@ def _tally_row(tel: Telemetry, row: dict) -> Optional[tuple]:
     tel.event("campaign.run", workload=row.get("workload"),
               nemesis=",".join(row.get("nemesis") or []),
               seed=row.get("seed"), status=status,
-              valid=row.get("valid"))
+              valid=row.get("valid"), host=row.get("host"))
     if status == "skipped":
         tel.counter("campaign.skipped")
         return None
@@ -515,6 +518,7 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
                  store_base: str = "store", name: str = "campaign",
                  start_method: str = "spawn",
                  live: bool = True,
+                 hosts=None,
                  on_row=None) -> dict:
     """Run a campaign: every spec through the pool, one shared checker
     service (optional), one summary. ``pool=0`` runs specs inline in
@@ -526,14 +530,33 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
     and the service carries ``<campaign trace>.svc`` — the artifacts
     join across processes by those ids. With ``live=True`` (default) a
     :class:`LiveCollector` aggregates the fleet's records into
-    ``live.json`` for serve.py's ``/live`` page as the campaign runs."""
+    ``live.json`` for serve.py's ``/live`` page as the campaign runs.
+
+    ``hosts`` switches the fan-out plane from a local process pool to
+    the multi-host topology (ROADMAP direction #4): an int spawns that
+    many local worker-agent processes (named ``host1..hostN`` — CI's
+    faked fleet over loopback TCP), a list of names does the same per
+    name. The checker service then listens on TCP with a
+    campaign-minted shared-secret token, every agent's runs ship their
+    device checks to it cross-host (attributed per host by the
+    JET-HOST preamble), and rows carry the host that ran them —
+    ``service.host_submitted.<host>`` vs the rows' summed
+    ``service_shipped`` is the cross-host ledger."""
     t0 = time.monotonic()
     cdir = make_store_dir(store_base, name)
     trace = f"{name}-{os.path.basename(cdir)}"
     tel = Telemetry(os.path.join(cdir, "telemetry.jsonl"), trace=trace)
+    if isinstance(hosts, int):
+        hosts = [f"host{i + 1}" for i in range(hosts)] if hosts else None
+    # the fleet auth token: minted per campaign, shared with the
+    # service and every spawned agent via env — never argv, never disk
+    token = hashlib.sha256(
+        f"{trace}-{os.getpid()}".encode()).hexdigest()[:16] \
+        if hosts else None
     svc = None
     svc_tel = None
     collector = None
+    agent_pool = None
     failures: list = []
     rows: list = [None] * len(specs)
     service_stats = None
@@ -554,8 +577,12 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
                 os.path.join(cdir, "service.jsonl"),
                 trace=f"{trace}.svc", parent=trace,
                 sink=None if collector is None else collector.path)
-            svc = CheckerService(tick_s=service_tick_s,
-                                 tel=svc_tel).start()
+            # hosts mode raises the TCP listener too: agents are other
+            # processes, so unix-socket reach is not enough — and the
+            # token gates every cross-host frame
+            svc = CheckerService(tick_s=service_tick_s, tel=svc_tel,
+                                 tcp=bool(hosts),
+                                 auth_token=token).start()
         run_specs = []
         for i, s in enumerate(specs):
             s = dict(s)
@@ -569,7 +596,13 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
             if collector is not None:
                 opts["live_sink"] = collector.path
             if svc is not None:
-                opts["checker_service"] = svc.path
+                # agents are separate hosts (in CI: separate
+                # processes), so they dial the TCP endpoint; the
+                # single-host pool keeps the unix socket
+                opts["checker_service"] = (svc.tcp_endpoint if hosts
+                                           else svc.path)
+                if token:
+                    opts["checker_service_token"] = token
             s["opts"] = opts
             run_specs.append(s)
         tel.counter("campaign.runs", len(run_specs))
@@ -606,7 +639,19 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
                 for row in _run_batched_cell(cell_specs, tel, genbatch):
                     _row_done(row)
             run_specs = pooled
-            if pool and pool > 0:
+            if hosts:
+                from .host_agent import HostAgentPool
+                agent_pool = HostAgentPool(token=token, tel=tel).start()
+                agent_pool.spawn_local(hosts)
+                ready = agent_pool.wait_ready(len(hosts), timeout=120.0)
+                tel.counter("campaign.hosts", ready)
+                if ready < len(hosts):
+                    logger.warning(
+                        "only %d/%d agents registered; stragglers' "
+                        "specs will run on the rest or inline",
+                        ready, len(hosts))
+                agent_pool.run(run_specs, _row_done)
+            elif pool and pool > 0:
                 import concurrent.futures as cf
                 import multiprocessing as mp
                 ctx = mp.get_context(start_method)
@@ -621,8 +666,14 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
         if svc is not None:
             service_stats = svc.stats()
     finally:
+        if agent_pool is not None:
+            agent_pool.close()
         if svc is not None:
             svc.close()
+            if service_stats is not None:
+                # only known post-join: stats() ran pre-close
+                service_stats["shutdown_leaked_threads"] = \
+                    svc.shutdown_leaked_threads
         if svc_tel is not None:
             # flush the service stream (counters + hists) to disk; the
             # campaign owns this recorder, not the service
@@ -649,6 +700,19 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
         for label, d in ((row or {}).get("hists") or {}).items():
             merged.setdefault(label, Hist()).merge(Hist.from_dict(d))
     hist_summaries = {label: h.to_dict() for label, h in merged.items()}
+    # per-host fold: which host ran what, and the cross-host ledger's
+    # producer side — each host's summed service_shipped must equal
+    # the service's service.host_submitted.<host> (consumer side)
+    by_host: dict = {}
+    for row in rows:
+        h = (row or {}).get("host")
+        if h is None:
+            continue
+        st = by_host.setdefault(h, {"runs": 0, "shipped": 0,
+                                    "fallbacks": 0})
+        st["runs"] += 1
+        st["shipped"] += int(row.get("service_shipped") or 0)
+        st["fallbacks"] += int(row.get("service_fallbacks") or 0)
     summary = {
         "name": name, "dir": cdir, "count": len(specs),
         "pool": pool,
@@ -660,6 +724,9 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
         "hists": hist_summaries,
         "p": {label: [d["p50"], d["p95"], d["p99"]]
               for label, d in hist_summaries.items()},
+        "hosts": by_host or None,
+        "agent_requeues": (agent_pool.requeues
+                           if agent_pool is not None else 0),
         "wall_s": round(time.monotonic() - t0, 3),
         "service": None if service_stats is None else {
             "socket": svc.path, **service_stats},

@@ -6,11 +6,19 @@ pool; if each run dispatched its own device checks it would pay the
 (PERF.md §1). This service is the continuous-batching answer (the
 Orca/vLLM scheduler shape from PAPERS.md applied to history checking):
 runner processes pack their histories ONCE (ops/wgl.py
-serialize_packed, ~32 B/op compact vectors), ship them over a local
-AF_UNIX socket, and the service coalesces everything pending across
-all connections into one ``wgl.check_packed_batch`` call per tick —
-one device dispatch per (bucket, width) group per tick, no matter how
-many runs contributed keys.
+serialize_packed, ~32 B/op compact vectors), ship them over a socket,
+and the service coalesces everything pending across all connections
+into one ``wgl.check_packed_batch`` call per tick — one device
+dispatch per (bucket, width) group per tick, no matter how many runs
+contributed keys.
+
+Transports (runner/transport.py): a local AF_UNIX socket (the
+original single-host shape) and TCP (``tcp://HOST:PORT``) for
+multi-host fleets, where generator hosts feed one device-owning
+service. TCP connections open with a ``JET-HOST <name>`` preamble
+(per-host attribution + net/ proxy sniffing) and authenticate with a
+shared-secret token carried on a ``hello`` frame; both transports
+enforce the per-message length cap before allocating a byte.
 
 Multi-device dispatch (ISSUE 15): the dispatcher assigns each
 (bucket, width) group to a chip with a STICKY round-robin map
@@ -40,17 +48,36 @@ PR 5 pinned that the spill verdict is bit-identical at every resume
 budget.
 
 Degradation contract: every client failure (no socket, connect
-refused, protocol error, service-side exception) returns ``None`` from
-``CheckerClient.check`` / ``client_for`` and bumps the
-``service.fallback`` counter — the checker then runs the same packs
-in-process, so a dead service costs latency, never verdicts.
+refused, protocol error, auth reject, heartbeat silence, service-side
+exception) returns ``None`` from ``CheckerClient.check`` /
+``client_for`` and bumps the ``service.fallback`` counter — the
+checker then runs the same packs in-process, so a dead service costs
+latency, never verdicts. Failures are NOT permanent: the client backs
+off under capped exponential delay with jitter and re-probes when the
+cooldown expires, so a healed service is automatically re-promoted
+(``service.reconnects``) mid-campaign.
 
-Wire format (length-prefixed frames, 8-byte little-endian size):
+Flow control: admission happens at the socket edge, not in the
+dispatcher. A ``check`` whose packs would overflow the bounded
+pending queue — or whose connection already has its in-flight quota
+out — is answered immediately with ``{"busy": true, "retry_after_s"}``
+(``service.admission_rejects``) instead of queueing unboundedly; the
+client sleeps and retries a bounded number of times before falling
+back in-process. While a request IS queued, the service sends
+heartbeat frames on its connection so the client can distinguish a
+slow tick from a dead service without a blind multi-minute wait.
+
+Wire format (length-prefixed frames, 8-byte little-endian size; TCP
+adds the ``JET-HOST <name>\\n`` text preamble before the first frame):
 
     request:  {"op": "check", "id": n, "sizes": [b0, b1, ...]}\\n
               <pack0 bytes><pack1 bytes>...
-    response: {"id": n, "results": [...]}        (or {"id", "error"})
-    also:     {"op": "ping"|"stats", "id": n} -> JSON-only responses
+    response: {"id": n, "results": [...]}        (or {"id", "error"}
+              or {"id", "busy": true, "retry_after_s": s})
+    also:     {"op": "hello", "id": n, "token": t, "host": h}
+              {"op": "ping"|"stats", "id": n} -> JSON-only responses
+    async:    {"heartbeat": k, "pending": p}  (service -> any client
+              with in-flight requests; not a reply, no id)
 """
 
 from __future__ import annotations
@@ -59,50 +86,36 @@ import json
 import logging
 import os
 import queue
+import random
 import socket
-import struct
 import tempfile
 import threading
 import time
+import zlib
 from typing import Any, Optional
 
-from . import telemetry
+from . import telemetry, transport
 from .telemetry import Telemetry
+from .transport import MAX_FRAME, FrameReader, send_frame as _send_frame
 
 logger = logging.getLogger("jepsen_etcd_tpu.checker_service")
 
-#: env var naming the service socket; opts/test["checker_service"] wins
+#: env var naming the service endpoint (unix path or tcp://HOST:PORT);
+#: opts/test["checker_service"] wins
 ENV_VAR = "JEPSEN_ETCD_TPU_CHECKER_SERVICE"
 
-_LEN = struct.Struct("<Q")
+#: env var carrying the shared-secret auth token;
+#: opts/test["checker_service_token"] wins
+ENV_TOKEN = "JEPSEN_ETCD_TPU_SERVICE_TOKEN"
 
-#: refuse frames past this size (a corrupt length prefix must not
-#: allocate the heap): 1 GiB >> any real campaign's per-request packs
-MAX_FRAME = 1 << 30
+#: env var naming this generator host for per-host attribution;
+#: opts/test["host_id"] wins
+ENV_HOST = "JEPSEN_ETCD_TPU_HOST"
 
-
-def _send_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(_LEN.pack(len(payload)) + payload)
-
-
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(n - len(buf), 1 << 20))
-        if not chunk:
-            return None
-        buf += chunk
-    return bytes(buf)
-
-
-def _recv_frame(sock: socket.socket) -> Optional[bytes]:
-    head = _recv_exact(sock, _LEN.size)
-    if head is None:
-        return None
-    (n,) = _LEN.unpack(head)
-    if n > MAX_FRAME:
-        raise ValueError(f"frame of {n} bytes exceeds MAX_FRAME")
-    return _recv_exact(sock, n)
+#: client reconnect backoff: capped exponential with jitter. Module
+#: level so tests can compress the clock.
+RETRY_BASE_S = 0.25
+RETRY_CAP_S = 30.0
 
 
 def _plain(x: Any) -> Any:
@@ -120,17 +133,32 @@ def _plain(x: Any) -> Any:
     return repr(x)
 
 
+class _Conn:
+    """One client connection's server-side state. ``inflight`` is the
+    admission-control ledger (requests queued or ticking, not yet
+    answered) and the heartbeat trigger; mutated under the service
+    ``_cv`` only."""
+
+    __slots__ = ("sock", "wlock", "tcp", "host", "authed", "inflight")
+
+    def __init__(self, sock, tcp=False):
+        self.sock = sock
+        self.wlock = threading.Lock()
+        self.tcp = tcp
+        self.host: Optional[str] = None
+        self.authed = False
+        self.inflight = 0
+
+
 class _Request:
     """One pending check request: its packs, arrival time, the
     originating run's trace id, and the connection to answer on."""
 
-    __slots__ = ("conn", "wlock", "req_id", "packs", "t_arrive",
-                 "trace")
+    __slots__ = ("client", "req_id", "packs", "t_arrive", "trace")
 
-    def __init__(self, conn, wlock, req_id, packs, t_arrive,
+    def __init__(self, client: _Conn, req_id, packs, t_arrive,
                  trace=None):
-        self.conn = conn
-        self.wlock = wlock
+        self.client = client
         self.req_id = req_id
         self.packs = packs
         self.t_arrive = t_arrive
@@ -305,32 +333,58 @@ class _Tick:
 class CheckerService:
     """The device-owning batch scheduler.
 
-    Threads: one acceptor, one reader per connection (they only parse
-    and enqueue), ONE dispatcher that freezes batches, packs host
-    tables, and places groups, and one worker per visible device that
-    runs the placed group dispatches (``_GroupJob.run``). Each chip's
-    launches stay serialized on its own worker — concurrent jax calls
-    only ever target DIFFERENT devices. All shared service state
-    (pending queue, connection list, worker queues, stop flag) is
-    mutated under ``_cv`` only; job state is handed off through the
-    per-job ``done`` event, and the placement map has its own lock.
+    Threads: one acceptor per listener (unix always, TCP when
+    enabled), one reader per connection (they only parse, admit, and
+    enqueue), ONE dispatcher that freezes batches, packs host tables,
+    and places groups, one worker per visible device that runs the
+    placed group dispatches (``_GroupJob.run``), and one heartbeat
+    sender. Each chip's launches stay serialized on its own worker —
+    concurrent jax calls only ever target DIFFERENT devices. All
+    shared service state (pending queue, admission ledgers, connection
+    list, worker queues, stop flag) is mutated under ``_cv`` only; job
+    state is handed off through the per-job ``done`` event, and the
+    placement map has its own lock.
     """
 
     def __init__(self, path: Optional[str] = None,
                  tick_s: float = 0.05,
-                 tel: Optional[Telemetry] = None):
+                 tel: Optional[Telemetry] = None,
+                 tcp=None,
+                 auth_token: Optional[str] = None,
+                 max_pending_packs: int = 512,
+                 max_inflight_per_conn: int = 8,
+                 heartbeat_s: float = 1.0,
+                 max_frame: int = MAX_FRAME,
+                 shutdown_join_s: float = 30.0):
         if path is None:
             path = os.path.join(
                 tempfile.mkdtemp(prefix="jet-checker-"), "checker.sock")
         self.path = path
         self.tick_s = tick_s
         self.tel = tel if tel is not None else Telemetry()
+        #: TCP listen spec: None/False -> unix only; True -> loopback
+        #: ephemeral port; int port or "HOST:PORT" -> explicit bind
+        self.tcp = tcp
+        self.tcp_endpoint: Optional[str] = None
+        self.auth_token = (auth_token if auth_token is not None
+                           else os.environ.get(ENV_TOKEN) or None)
+        self.max_pending_packs = max_pending_packs
+        self.max_inflight_per_conn = max_inflight_per_conn
+        self.heartbeat_s = heartbeat_s
+        self.max_frame = max_frame
+        self.shutdown_join_s = shutdown_join_s
+        #: threads still alive after close() gave up joining them —
+        #: surfaced in stats() and the service.shutdown_leaked_threads
+        #: counter so a wedged worker is a ledger entry, not a mystery
+        self.shutdown_leaked_threads = 0
         self._cv = threading.Condition()
         self._pending: list[_Request] = []
-        self._conns: list[socket.socket] = []
+        self._pending_packs = 0  # admission ledger: queued + ticking
+        self._conns: list[_Conn] = []
         self._threads: list[threading.Thread] = []
         self._stopped = False
         self._listener: Optional[socket.socket] = None
+        self._tcp_listener: Optional[socket.socket] = None
         #: sticky group→device map; lazy so constructing a service
         #: (tests, option plumbing) never imports jax
         self._placement = DevicePlacement()
@@ -348,18 +402,34 @@ class CheckerService:
         # closing a listener does NOT wake a blocked accept() on
         # Linux; poll with a short timeout so close() never hangs
         ls.settimeout(0.25)
+        ts = None
+        if self.tcp:
+            ts, self.tcp_endpoint = transport.listen_tcp(self.tcp)
+            ts.settimeout(0.25)
         with self._cv:
             self._listener = ls
-            acceptor = threading.Thread(
-                target=self._accept_loop, name="checker-svc-accept",
-                daemon=True)
-            dispatcher = threading.Thread(
-                target=self._dispatch_loop, name="checker-svc-dispatch",
-                daemon=True)
-            self._threads += [acceptor, dispatcher]
-        acceptor.start()
-        dispatcher.start()
-        logger.info("checker service listening on %s", self.path)
+            self._tcp_listener = ts
+            threads = [
+                threading.Thread(
+                    target=self._accept_loop, args=(ls, False),
+                    name="checker-svc-accept", daemon=True),
+                threading.Thread(
+                    target=self._dispatch_loop,
+                    name="checker-svc-dispatch", daemon=True),
+                threading.Thread(
+                    target=self._heartbeat_loop,
+                    name="checker-svc-heartbeat", daemon=True),
+            ]
+            if ts is not None:
+                threads.append(threading.Thread(
+                    target=self._accept_loop, args=(ts, True),
+                    name="checker-svc-accept-tcp", daemon=True))
+            self._threads += threads
+        for t in threads:
+            t.start()
+        logger.info("checker service listening on %s%s", self.path,
+                    f" and {self.tcp_endpoint}" if ts is not None
+                    else "")
         return self
 
     def close(self) -> None:
@@ -368,27 +438,39 @@ class CheckerService:
                 return
             self._stopped = True
             self._cv.notify_all()
-            ls = self._listener
+            listeners = [self._listener, self._tcp_listener]
             conns = list(self._conns)
             threads = list(self._threads)
-        if ls is not None:
-            try:
-                ls.close()
-            except OSError:
-                pass
+        for ls in listeners:
+            if ls is not None:
+                try:
+                    ls.close()
+                except OSError:
+                    pass
         for c in conns:
             # shutdown (not just close) reliably wakes a reader
             # blocked in recv() on this connection
             try:
-                c.shutdown(socket.SHUT_RDWR)
+                c.sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
             try:
-                c.close()
+                c.sock.close()
             except OSError:
                 pass
         for t in threads:
-            t.join(timeout=30)
+            t.join(timeout=self.shutdown_join_s)
+        leaked = [t.name for t in threads if t.is_alive()]
+        if leaked:
+            # a thread that outlived its join grace is leaked, not
+            # merely slow: say so and put it on the ledger instead of
+            # silently discarding the join result
+            logger.warning(
+                "checker service shutdown leaked %d thread(s): %s",
+                len(leaked), ", ".join(sorted(leaked)))
+            self.tel.counter("service.shutdown_leaked_threads",
+                             len(leaked))
+        self.shutdown_leaked_threads = len(leaked)
         try:
             os.unlink(self.path)
         except OSError:
@@ -396,92 +478,184 @@ class CheckerService:
 
     def stats(self) -> dict:
         """The service's telemetry summary (counters + spans) plus the
-        device roster and sticky placement map. Uses the non-forcing
-        device peek so a stats RPC from a reader thread never
-        initializes jax — empty lists until the first tick ran."""
+        device roster, sticky placement map, and transport endpoints.
+        Uses the non-forcing device peek so a stats RPC from a reader
+        thread never initializes jax — empty lists until the first
+        tick ran."""
         out = self.tel.summary()
         out["devices"] = [device_name(d)
                           for d in self._placement.devices_if_known()]
         out["placement"] = self._placement.snapshot()
+        out["endpoint"] = self.path
+        out["tcp_endpoint"] = self.tcp_endpoint
+        out["shutdown_leaked_threads"] = self.shutdown_leaked_threads
         return out
 
     # -- socket side ---------------------------------------------------------
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, ls: socket.socket, tcp: bool) -> None:
         while True:
             with self._cv:
                 if self._stopped:
                     return
-                ls = self._listener
             try:
                 conn, _ = ls.accept()
             except socket.timeout:
                 continue  # poll the stop flag
             except OSError:
                 return  # listener closed by close()
-            wlock = threading.Lock()
+            cstate = _Conn(conn, tcp=tcp)
             reader = threading.Thread(
-                target=self._reader, args=(conn, wlock),
+                target=self._reader, args=(cstate,),
                 name="checker-svc-reader", daemon=True)
             with self._cv:
                 if self._stopped:
                     conn.close()
                     return
-                self._conns.append(conn)
+                self._conns.append(cstate)
                 self._threads.append(reader)
             reader.start()
 
-    def _reader(self, conn: socket.socket, wlock: threading.Lock) -> None:
+    def _reader(self, cstate: _Conn) -> None:
         try:
+            reader = FrameReader(cstate.sock, max_frame=self.max_frame)
+            if cstate.tcp:
+                # TCP opens with "JET-HOST <name>\n" — the same line
+                # the net/ proxy sniffs for fault attribution; absent
+                # (a bare frame) the connection is simply anonymous
+                host = reader.read_preamble()
+                if host:
+                    with self._cv:
+                        cstate.host = host
             while True:
-                frame = _recv_frame(conn)
+                frame = reader.recv_frame()
                 if frame is None:
                     return
-                self._handle(conn, wlock, frame)
+                self._handle(cstate, frame)
         except (OSError, ValueError) as e:
             logger.debug("checker service reader exits: %r", e)
         finally:
             with self._cv:
-                if conn in self._conns:
-                    self._conns.remove(conn)
+                if cstate in self._conns:
+                    self._conns.remove(cstate)
             try:
-                conn.close()
+                cstate.sock.close()
             except OSError:
                 pass
 
-    def _handle(self, conn, wlock, frame: bytes) -> None:
+    def _reply(self, cstate: _Conn, payload: dict) -> None:
+        with cstate.wlock:
+            _send_frame(cstate.sock, json.dumps(payload).encode())
+
+    def _handle(self, cstate: _Conn, frame: bytes) -> None:
         from ..ops import wgl
         nl = frame.index(b"\n") if b"\n" in frame else len(frame)
         head = json.loads(frame[:nl].decode())
         op = head.get("op")
-        if op == "ping":
-            with wlock:
-                _send_frame(conn, json.dumps(
-                    {"id": head.get("id"), "ok": True}).encode())
+        rid = head.get("id")
+        if op == "hello":
+            if self.auth_token and head.get("token") != self.auth_token:
+                self.tel.counter("service.auth_rejects")
+                self._reply(cstate, {"id": rid,
+                                     "error": "bad auth token"})
+                raise ValueError("auth token rejected")
+            with self._cv:
+                cstate.authed = True
+                if head.get("host"):
+                    cstate.host = head["host"]
+            self._reply(cstate, {"id": rid, "ok": True})
             return
+        if op == "ping":
+            self._reply(cstate, {"id": rid, "ok": True})
+            return
+        if self.auth_token and not cstate.authed:
+            # ping stays open as an unauthenticated liveness probe;
+            # everything that reads or submits state requires hello
+            self.tel.counter("service.auth_rejects")
+            self._reply(cstate, {"id": rid, "error": "auth required"})
+            raise ValueError("unauthenticated request")
         if op == "stats":
-            with wlock:
-                _send_frame(conn, json.dumps(
-                    {"id": head.get("id"),
-                     "stats": self.stats()}).encode())
+            self._reply(cstate, {"id": rid, "stats": self.stats()})
             return
         if op != "check":
-            with wlock:
-                _send_frame(conn, json.dumps(
-                    {"id": head.get("id"),
-                     "error": f"unknown op {op!r}"}).encode())
+            self._reply(cstate, {"id": rid,
+                                 "error": f"unknown op {op!r}"})
             return
-        packs = []
-        off = nl + 1
-        for size in head["sizes"]:
-            packs.append(wgl.deserialize_packed(frame[off:off + size]))
-            off += size
-        req = _Request(conn, wlock, head.get("id"), packs,
-                       time.monotonic(), trace=head.get("trace"))
+        sizes = head["sizes"]
+        n = len(sizes)
+        # admission BEFORE deserialization: an over-capacity request
+        # costs a JSON head parse and one small reply, never a pack
+        # decode or an unbounded queue slot
+        with self._cv:
+            over = (cstate.inflight >= self.max_inflight_per_conn
+                    or self._pending_packs + n > self.max_pending_packs)
+            if not over:
+                cstate.inflight += 1
+                self._pending_packs += n
+        if over:
+            self.tel.counter("service.admission_rejects")
+            self._reply(cstate, {
+                "id": rid, "busy": True,
+                "retry_after_s": round(max(2 * self.tick_s, 0.05), 3)})
+            return
+        try:
+            packs = []
+            off = nl + 1
+            for size in sizes:
+                packs.append(
+                    wgl.deserialize_packed(frame[off:off + size]))
+                off += size
+        except Exception as e:
+            # a malformed pack (wrong wire version mid-stream, torn
+            # blob) degrades THIS request, not the connection: refund
+            # the admission slots and answer with a structured error
+            with self._cv:
+                cstate.inflight -= 1
+                self._pending_packs -= n
+            self.tel.counter("service.bad_requests")
+            logger.warning("checker service rejected request: %r", e)
+            self._reply(cstate, {"id": rid, "error": repr(e)})
+            return
+        req = _Request(cstate, rid, packs, time.monotonic(),
+                       trace=head.get("trace"))
         self.tel.counter("service.requests")
         self.tel.counter("service.submitted", len(packs))
+        if cstate.host:
+            self.tel.counter("service.host_submitted." + cstate.host,
+                             len(packs))
         with self._cv:
             self._pending.append(req)
             self._cv.notify_all()
+
+    def _heartbeat_loop(self) -> None:
+        """Periodically beat every connection with in-flight requests:
+        a queued client hears ``{"heartbeat": k, "pending": p}`` once
+        per interval, so silence longer than its idle timeout means
+        the service is DEAD, not slow — no blind 600 s waits."""
+        seq = 0
+        while True:
+            with self._cv:
+                if self._stopped:
+                    return
+                self._cv.wait(timeout=self.heartbeat_s)
+                if self._stopped:
+                    return
+                targets = [c for c in self._conns if c.inflight > 0]
+                pending = self._pending_packs
+            if not targets:
+                continue
+            seq += 1
+            payload = json.dumps({"heartbeat": seq,
+                                  "pending": pending}).encode()
+            sent = 0
+            for c in targets:
+                try:
+                    with c.wlock:
+                        _send_frame(c.sock, payload)
+                    sent += 1
+                except OSError:
+                    continue  # reader notices the dead conn
+            if sent:
+                self.tel.counter("service.heartbeats_sent", sent)
 
     # -- device side ---------------------------------------------------------
     def _dispatch_loop(self) -> None:
@@ -638,6 +812,8 @@ class CheckerService:
         worker)."""
         runs = sorted({req.trace for req in tick.batch
                        if req.trace is not None})
+        hosts = sorted({req.client.host for req in tick.batch
+                        if req.client.host is not None})
         dev_names = sorted({nm for job, _i, _q in tick.jobs
                             for nm in job.dev_names})
         dev_attr = (dev_names[0] if len(dev_names) == 1
@@ -646,7 +822,8 @@ class CheckerService:
         tick.span = self.tel.span(
             "service.tick", packs=tick.n_packs,
             requests=len(tick.batch), groups=tick.n_groups,
-            runs=runs, device=dev_attr, sharded=bool(tick.sharded))
+            runs=runs, hosts=hosts, device=dev_attr,
+            sharded=bool(tick.sharded))
         tick.span.__enter__()
         with self._cv:
             qs = list(self._work_qs)
@@ -737,10 +914,15 @@ class CheckerService:
                            "results": results_by_req[ri],
                            "queue_wait_s": waits[ri]}
             try:
-                with req.wlock:
-                    _send_frame(req.conn, json.dumps(payload).encode())
+                self._reply(req.client, payload)
             except OSError:
                 logger.debug("checker service: client went away")
+            finally:
+                # refund the admission slots whether or not the client
+                # lived to hear the answer — the ledger must drain
+                with self._cv:
+                    req.client.inflight -= 1
+                    self._pending_packs -= len(req.packs)
 
 
 # ---------------------------------------------------------------------------
@@ -753,54 +935,157 @@ class ServiceUnavailable(Exception):
 
 class CheckerClient:
     """Synchronous client: one request outstanding at a time (the
-    checker blocks on its verdicts anyway). Any failure marks the
-    client broken; callers fall back to in-process checking."""
+    checker blocks on its verdicts anyway).
 
-    def __init__(self, path: str, timeout: float = 600.0):
-        self.path = path
+    Failures are never permanent. A transport failure closes the
+    socket and arms a cooldown (capped exponential backoff + jitter);
+    calls during the cooldown raise :class:`ServiceUnavailable`
+    immediately (the caller falls back in-process for THAT call), and
+    the first call after it expires re-connects — counting
+    ``service.reconnects`` when it succeeds, so a healed service is
+    re-promoted automatically. While waiting for a verdict the client
+    only tolerates ``idle_timeout`` seconds of SILENCE: the service
+    heartbeats queued connections every second, so silence means dead,
+    not slow — the old blind 600 s wait survives only as the overall
+    ``timeout`` ceiling.
+    """
+
+    def __init__(self, endpoint: str, timeout: float = 600.0,
+                 token: Optional[str] = None,
+                 host: Optional[str] = None,
+                 connect_timeout: float = 5.0,
+                 idle_timeout: float = 20.0,
+                 max_busy_retries: int = 4):
+        self.endpoint = endpoint
+        #: legacy alias (the client predates TCP endpoints)
+        self.path = endpoint
         self.timeout = timeout
-        self._lock = threading.Lock()
+        self.token = (token if token is not None
+                      else os.environ.get(ENV_TOKEN) or None)
+        if host is None and transport.is_tcp(endpoint):
+            host = (os.environ.get(ENV_HOST)
+                    or socket.gethostname() or "client")
+        self.host = host
+        self.connect_timeout = connect_timeout
+        self.idle_timeout = idle_timeout
+        self.max_busy_retries = max_busy_retries
+        # reentrant: the helpers re-take it around their own state
+        # writes even though _rpc already holds it
+        self._lock = threading.RLock()
         self._sock: Optional[socket.socket] = None
+        self._reader: Optional[FrameReader] = None
         self._next_id = 0
-        self.broken = False
+        self._fails = 0
+        self._retry_at = 0.0
+        # deterministic jitter per endpoint: no two clients of one
+        # campaign re-probe a healing service in lockstep
+        self._rng = random.Random(zlib.crc32(endpoint.encode()))
         #: queue wait the service attributed to the LAST check() reply
         #: (seconds); None until a reply carries one
         self.last_queue_wait_s: Optional[float] = None
 
+    # -- health --------------------------------------------------------------
+    @property
+    def broken(self) -> bool:
+        """True while the reconnect cooldown is armed (the old
+        permanent latch, now with an expiry date)."""
+        return self._fails > 0 and time.monotonic() < self._retry_at
+
+    def available(self) -> bool:
+        return not self.broken
+
+    @property
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    @property
+    def fails(self) -> int:
+        return self._fails
+
+    # -- transport -----------------------------------------------------------
+    def _mark_failed_locked(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                self._reader = None
+            self._fails += 1
+            delay = min(RETRY_CAP_S,
+                        RETRY_BASE_S * (2 ** min(self._fails - 1, 16)))
+            delay *= 0.5 + self._rng.random()  # jitter in [0.5x, 1.5x)
+            self._retry_at = time.monotonic() + delay
+
+    def _exchange_locked(self, head: dict, body: bytes = b"") -> dict:
+        head = dict(head)
+        with self._lock:
+            head["id"] = self._next_id
+            self._next_id += 1
+        _send_frame(self._sock, json.dumps(head).encode() + b"\n"
+                    + body)
+        deadline = time.monotonic() + self.timeout
+        while True:
+            # FrameReader is re-entrant across socket timeouts, but an
+            # idle timeout here means NO bytes — not even a heartbeat
+            # — for idle_timeout seconds: the service is dead or cut
+            frame = self._reader.recv_frame()
+            if frame is None:
+                raise ConnectionError("connection closed by service")
+            resp = json.loads(frame.decode())
+            if "heartbeat" in resp:
+                telemetry.current().counter("service.heartbeats_seen")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no reply within timeout={self.timeout}s "
+                        "(service alive but stuck)")
+                continue
+            if resp.get("id") != head["id"]:
+                continue  # stale reply from an abandoned exchange
+            return resp
+
+    def _connect_locked(self) -> None:
+        if self._sock is not None:
+            return
+        s = transport.connect(self.endpoint,
+                              timeout=self.connect_timeout)
+        s.settimeout(self.idle_timeout)
+        if transport.is_tcp(self.endpoint):
+            transport.send_preamble(s, self.host or "client")
+        with self._lock:
+            self._sock = s
+            self._reader = FrameReader(s)
+        hello = {"op": "hello"}
+        if self.token is not None:
+            hello["token"] = self.token
+        if self.host is not None:
+            hello["host"] = self.host
+        resp = self._exchange_locked(hello)
+        if resp.get("error"):
+            raise ConnectionError(f"hello rejected: {resp['error']}")
+        with self._lock:
+            if self._fails:
+                telemetry.current().counter("service.reconnects")
+            self._fails = 0
+            self._retry_at = 0.0
+
     def _rpc(self, head: dict, body: bytes = b"") -> dict:
         with self._lock:
-            if self.broken:
-                raise ServiceUnavailable(self.path)
+            now = time.monotonic()
+            if self._fails and now < self._retry_at:
+                raise ServiceUnavailable(
+                    f"{self.endpoint}: cooling down "
+                    f"{self._retry_at - now:.2f}s after "
+                    f"{self._fails} failure(s)")
             try:
-                if self._sock is None:
-                    s = socket.socket(socket.AF_UNIX,
-                                      socket.SOCK_STREAM)
-                    s.settimeout(self.timeout)
-                    s.connect(self.path)
-                    self._sock = s
-                sock = self._sock
-                head = dict(head)
-                head["id"] = self._next_id
-                self._next_id += 1
-                _send_frame(sock, json.dumps(head).encode() + b"\n"
-                            + body)
-                frame = _recv_frame(sock)
-                if frame is None:
-                    raise ServiceUnavailable("connection closed")
-                resp = json.loads(frame.decode())
-                if resp.get("id") != head["id"]:
-                    raise ServiceUnavailable("response id mismatch")
-                return resp
+                self._connect_locked()
+                return self._exchange_locked(head, body)
             except (OSError, ValueError, json.JSONDecodeError) as e:
-                self.broken = True
-                if self._sock is not None:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
+                self._mark_failed_locked()
                 raise ServiceUnavailable(repr(e)) from e
 
+    # -- API -----------------------------------------------------------------
     def ping(self) -> bool:
         try:
             return bool(self._rpc({"op": "ping"}).get("ok"))
@@ -816,25 +1101,39 @@ class CheckerClient:
     def check(self, packs: list,
               trace: Optional[str] = None) -> Optional[list]:
         """Ship packed histories; returns one verdict dict per pack
-        (aligned), or None if the service failed — callers MUST then
-        check the same packs in-process. ``trace`` is the originating
-        run's trace id: the service stamps it on the dispatch tick
-        span so the shipped-packs ledger is joinable per run."""
+        (aligned), or None if the service failed or stayed saturated —
+        callers MUST then check the same packs in-process. ``trace``
+        is the originating run's trace id: the service stamps it on
+        the dispatch tick span so the shipped-packs ledger is joinable
+        per run. A BUSY reply (admission control) is retried under a
+        short bounded backoff — the transport is healthy, so it never
+        arms the reconnect cooldown."""
         from ..ops import wgl
-        try:
-            blobs = [wgl.serialize_packed(p) for p in packs]
-            head = {"op": "check", "sizes": [len(b) for b in blobs]}
-            if trace is not None:
-                head["trace"] = trace
-            resp = self._rpc(head, b"".join(blobs))
-        except ServiceUnavailable:
-            return None
-        self.last_queue_wait_s = resp.get("queue_wait_s")
+        blobs = [wgl.serialize_packed(p) for p in packs]
+        head = {"op": "check", "sizes": [len(b) for b in blobs]}
+        if trace is not None:
+            head["trace"] = trace
+        body = b"".join(blobs)
+        for attempt in range(self.max_busy_retries + 1):
+            try:
+                resp = self._rpc(head, body)
+            except ServiceUnavailable:
+                return None
+            if resp.get("busy"):
+                telemetry.current().counter("service.busy_retries")
+                if attempt == self.max_busy_retries:
+                    return None  # saturated: fall back in-process
+                wait = float(resp.get("retry_after_s") or 0.05)
+                time.sleep(min(wait * (attempt + 1), 2.0))
+                continue
+            break
+        with self._lock:
+            self.last_queue_wait_s = resp.get("queue_wait_s")
         results = resp.get("results")
         if results is None or len(results) != len(packs):
             # a structured error reply (a failed tick): the transport
-            # is healthy, so DON'T latch broken — this call falls back
-            # to in-process checking, the next may succeed again
+            # is healthy, so no cooldown — this call falls back to
+            # in-process checking, the next may succeed again
             return None
         return results
 
@@ -846,47 +1145,67 @@ class CheckerClient:
                 except OSError:
                     pass
                 self._sock = None
+                self._reader = None
 
 
-#: per-process client cache; None latches "tried and broken" so a dead
-#: service costs one connect attempt per process, not one per key batch
-_clients: dict[str, Optional[CheckerClient]] = {}
+#: per-process client cache. Entries are kept across failures — the
+#: client's own backoff cooldown IS the negative cache, and it
+#: expires, so a healed service gets re-probed instead of being
+#: latched dead for the life of the process.
+_clients: dict[str, CheckerClient] = {}
 _clients_lock = threading.Lock()
 
 
 def endpoint_for(test: Any) -> Optional[str]:
-    """The configured service socket for a test dict (or env), if any."""
-    path = None
+    """The configured service endpoint (unix path or tcp://HOST:PORT)
+    for a test dict (or env), if any."""
+    ep = None
     if isinstance(test, dict):
-        path = test.get("checker_service")
-    return path or os.environ.get(ENV_VAR) or None
+        ep = test.get("checker_service")
+    return ep or os.environ.get(ENV_VAR) or None
+
+
+def token_for(test: Any) -> Optional[str]:
+    tok = None
+    if isinstance(test, dict):
+        tok = test.get("checker_service_token")
+    return tok or os.environ.get(ENV_TOKEN) or None
+
+
+def host_for(test: Any) -> Optional[str]:
+    host = None
+    if isinstance(test, dict):
+        host = test.get("host_id")
+    return host or os.environ.get(ENV_HOST) or None
 
 
 def client_for(test: Any) -> Optional[CheckerClient]:
     """A working (cached) client for the test's service endpoint, or
-    None — absent config, failed connect, or a previously broken
-    client all mean "check in-process"."""
-    path = endpoint_for(test)
-    if not path:
+    None — absent config, failed connect, or a client inside its
+    reconnect cooldown all mean "check in-process THIS call". Unlike
+    the old permanent latch, a dead endpoint is re-probed once per
+    backoff window, so a service that comes up mid-campaign is
+    adopted automatically."""
+    endpoint = endpoint_for(test)
+    if not endpoint:
         return None
     with _clients_lock:
-        if path in _clients:
-            c = _clients[path]
-            if c is not None and c.broken:
-                _clients[path] = None
-                c = None
-            return c
-    client = CheckerClient(path)
-    ok = client.ping()
-    with _clients_lock:
-        _clients[path] = client if ok else None
-    if not ok:
-        # callers count service.fallback per degraded check; here just
-        # explain the latch once
-        logger.warning("checker service unreachable at %s; "
-                       "checking in-process", path)
-        return None
-    return _clients[path]
+        client = _clients.get(endpoint)
+        if client is None:
+            client = CheckerClient(endpoint, token=token_for(test),
+                                   host=host_for(test))
+            _clients[endpoint] = client
+    if client.connected:
+        return client
+    if not client.available():
+        return None  # cooling down; the entry expires on its own
+    if client.ping():
+        return client
+    log = logger.warning if client.fails == 1 else logger.debug
+    log("checker service unreachable at %s; checking in-process "
+        "(retry in <=%.1fs)", endpoint,
+        max(0.0, client._retry_at - time.monotonic()))
+    return None
 
 
 def reset_clients() -> None:
@@ -894,6 +1213,5 @@ def reset_clients() -> None:
     clean anyway)."""
     with _clients_lock:
         for c in _clients.values():
-            if c is not None:
-                c.close()
+            c.close()
         _clients.clear()

@@ -124,6 +124,12 @@ REGISTRY = {
         "campaign.failed",
         "campaign.skipped",
         "campaign.errors",
+        "campaign.hosts",         # runner/host_agent.py fan-out:
+                                  # worker agents registered at sweep
+                                  # start
+        "campaign.agent_requeues",  # specs re-queued after an agent
+                                  # died mid-run (requeue-capped; past
+                                  # the cap the driver runs inline)
         "service.requests",       # runner/checker_service.py batching:
         "service.submitted",      # packs received across all runners
         "service.coalesced",      # packs beyond the first per group
@@ -165,6 +171,32 @@ REGISTRY = {
         "service.shipped",        # runner-side packs shipped; summed
                                   # over a campaign's runs this equals
                                   # the service's service.submitted
+        "service.host_submitted.*",  # packs received per generator
+                                  # host (JET-HOST preamble); ledger:
+                                  # Σ over hosts' rows'
+                                  # service_shipped == this series —
+                                  # the cross-host shipped==submitted
+                                  # join
+        "service.admission_rejects",  # check requests bounced BUSY at
+                                  # the door (queue/in-flight caps) —
+                                  # counted BEFORE deserialization
+        "service.busy_retries",   # client-side: BUSY replies absorbed
+                                  # by backoff-and-retry
+        "service.auth_rejects",   # hello frames with a wrong/missing
+                                  # shared-secret token
+        "service.reconnects",     # client-side: successful reconnects
+                                  # after >=1 failure (the broken
+                                  # latch healing)
+        "service.heartbeats_sent",  # service-side liveness frames to
+                                  # connections with in-flight work
+        "service.heartbeats_seen",  # client-side heartbeats consumed
+                                  # while waiting (distinguishes slow
+                                  # from dead)
+        "service.bad_requests",   # undeserializable/oversized check
+                                  # bodies answered with a structured
+                                  # error (connection survives)
+        "service.shutdown_leaked_threads",  # threads still alive
+                                  # after close() joins timed out
         "independent.keys",       # per-key fanout of the independent
                                   # split (the producer side of the
                                   # batching axis)

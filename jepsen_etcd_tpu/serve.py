@@ -193,6 +193,12 @@ def _campaign_rows(store_base: str) -> list[dict]:
                 "chips": _chip_util(sctr),
                 "fallbacks": sum(int(r.get("service_fallbacks") or 0)
                                  for r in done),
+                # multi-host campaigns: per-host run/shipped fold
+                # joined against the service's per-host submitted
+                # series (the cross-host ledger, runner/host_agent.py)
+                "hosts": _host_ledger(summary, sctr),
+                "agent_requeues": int(
+                    summary.get("agent_requeues") or 0),
                 # campaign-wide merged-histogram percentiles
                 # ({label: [p50, p95, p99]}, seconds)
                 "p": summary.get("p") if isinstance(summary.get("p"),
@@ -201,6 +207,23 @@ def _campaign_rows(store_base: str) -> list[dict]:
             })
     rows.sort(key=lambda r: r["mtime"])
     return rows
+
+
+def _host_ledger(summary: dict, sctr: dict) -> dict | None:
+    """Per-host attribution for a multi-host campaign: the rows' fold
+    (runs + shipped per host, producer side) joined with the service's
+    ``service.host_submitted.<host>`` counters (consumer side). The
+    two shipped numbers must agree — that is the cross-host
+    shipped==submitted ledger. None for single-host campaigns."""
+    hosts = summary.get("hosts")
+    if not isinstance(hosts, dict) or not hosts:
+        return None
+    out = {}
+    for h, st in sorted(hosts.items()):
+        st = dict(st) if isinstance(st, dict) else {}
+        st["submitted"] = sctr.get("service.host_submitted." + h)
+        out[h] = st
+    return out
 
 
 def _chip_util(sctr: dict) -> dict | None:
@@ -391,7 +414,7 @@ def aggregate_html(store_base: str) -> str:
             "<th>check wall</th>"
             "<th>p95 gen/check/queue</th><th>net</th>"
             "<th>dispatches</th><th>amortization</th>"
-            "<th>chips</th></tr>")
+            "<th>chips</th><th>hosts</th></tr>")
         for c in camps:
             when = time.strftime("%Y-%m-%d %H:%M",
                                  time.localtime(c["mtime"]))
@@ -445,6 +468,28 @@ def aggregate_html(store_base: str) -> str:
                     + (f", {sh} sharded" if sh else "") + "</td>")
             else:
                 chips_td = "<td class='dim'>—</td>"
+            hosts = c.get("hosts")
+            if hosts:
+                # the ledger join per host: rows' shipped (producer)
+                # vs the service's host_submitted (consumer)
+                balanced = all(
+                    st.get("submitted") is None
+                    or st.get("shipped") == st.get("submitted")
+                    for st in hosts.values())
+                title = ", ".join(
+                    f"{h}: {st.get('runs', 0)} runs, "
+                    f"shipped {st.get('shipped', 0)} vs "
+                    f"submitted {st.get('submitted', '?')}"
+                    for h, st in sorted(hosts.items()))
+                rq = c.get("agent_requeues")
+                hosts_td = (
+                    f"<td title='{html.escape(title)}'>"
+                    f"{len(hosts)} hosts, ledger "
+                    + ("<span class='ok'>balanced</span>" if balanced
+                       else "<span class='bad'>MISMATCH</span>")
+                    + (f", {rq} requeues" if rq else "") + "</td>")
+            else:
+                hosts_td = "<td class='dim'>—</td>"
             out.append(
                 f'<tr><td><a href="/{quote(c["dir"])}/?files">'
                 f'{html.escape(c["dir"])}</a></td>'
@@ -454,7 +499,7 @@ def aggregate_html(store_base: str) -> str:
                 f"<td>{c['wall_s']}s</td>{rate_td}{gb_td}"
                 f"<td>{c['check_s']:.2f}s</td>{p_td}{net_td}"
                 f"<td>{c['dispatches']}</td><td>{amort}</td>"
-                f"{chips_td}</tr>")
+                f"{chips_td}{hosts_td}</tr>")
         out.append("</table>")
 
     # -- failure dedupe by verdict signature ---------------------------------
@@ -745,11 +790,12 @@ def live_html() -> str:
             "' dropped</span>':'')+'</p>';\n"
             " const runs=Object.entries(d.runs||{});\n"
             " h+='<h2>Runs ('+runs.length+')</h2><table><tr>"
-            "<th>trace</th><th>status</th><th>phase</th>"
+            "<th>trace</th><th>host</th><th>status</th><th>phase</th>"
             "<th>spans</th><th>valid</th></tr>';\n"
             " runs.sort();\n"
             " for(const[t,r]of runs){h+='<tr><td><code>'+t+"
-            "'</code></td><td>'+(r.status||'running')+'</td><td>'+"
+            "'</code></td><td>'+(r.host||'—')+'</td><td>'+"
+            "(r.status||'running')+'</td><td>'+"
             "(r.phase||'—')+'</td><td>'+(r.spans||0)+'</td><td>'+"
             "(r.valid===true?'<span class=ok>true</span>':"
             "(r.valid===false?'<span class=bad>false</span>':'—'))+"

@@ -13,7 +13,9 @@ checks locally, never an error, never a changed verdict.
 import dataclasses
 import json
 import random
+import socket
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -22,6 +24,7 @@ from jepsen_etcd_tpu.core.history import History
 from jepsen_etcd_tpu.checkers.tpu_linearizable import TPULinearizableChecker
 from jepsen_etcd_tpu.ops import wgl
 from jepsen_etcd_tpu.runner import checker_service as svc_mod
+from jepsen_etcd_tpu.runner import transport
 from jepsen_etcd_tpu.runner import telemetry
 from jepsen_etcd_tpu.runner.telemetry import Telemetry
 
@@ -197,13 +200,223 @@ def test_checker_falls_back_when_service_down(tmp_path):
     assert ctr.get("service.fallback") == 1, ctr
 
 
-def test_client_cache_latches_broken(tmp_path):
+def test_client_for_negative_cache_expires_and_repromotes(tmp_path,
+                                                          monkeypatch):
+    """The old permanent latch, fixed: a dead endpoint is a cooldown
+    entry (no connect storm while it lasts), and once it expires the
+    endpoint is re-probed — a service that comes up mid-campaign is
+    adopted without any reset."""
+    monkeypatch.setattr(svc_mod, "RETRY_BASE_S", 0.05)
+    monkeypatch.setattr(svc_mod, "RETRY_CAP_S", 0.1)
     svc_mod.reset_clients()
-    test = {"checker_service": str(tmp_path / "gone.sock")}
-    assert svc_mod.client_for(test) is None
-    # second lookup hits the latched None, no second connect attempt
-    assert svc_mod.client_for(test) is None
-    svc_mod.reset_clients()
+    path = str(tmp_path / "late.sock")
+    test = {"checker_service": path}
+    try:
+        assert svc_mod.client_for(test) is None
+        cached = svc_mod._clients[path]
+        assert cached.fails == 1 and cached.broken
+        # during the cooldown: negative-cached, no second dial
+        assert svc_mod.client_for(test) is None
+        assert cached.fails == 1
+        # the service comes up late, the cooldown expires: re-promoted
+        svc = svc_mod.CheckerService(path=path, tick_s=0.01).start()
+        try:
+            deadline = time.monotonic() + 5.0
+            client = None
+            while client is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+                client = svc_mod.client_for(test)
+            assert client is cached, "healed endpoint not re-promoted"
+            assert not client.broken and client.fails == 0
+            pack = make_packs(61, 1)[0]
+            outs = client.check([pack])
+            assert outs is not None
+            assert view(outs[0]) == view(wgl.check_packed(pack))
+        finally:
+            svc.close()
+    finally:
+        svc_mod.reset_clients()
+
+
+# -- TCP transport, auth, admission, reconnect -------------------------------
+
+def test_tcp_transport_auth_and_host_attribution():
+    """The TCP listener speaks the same framed protocol as the unix
+    socket, rejects a wrong shared secret at hello, and attributes
+    submitted packs to the connecting host's ledger entry."""
+    svc = svc_mod.CheckerService(tick_s=0.01, tcp=True,
+                                 auth_token="sekrit").start()
+    try:
+        assert svc.tcp_endpoint and svc.tcp_endpoint.startswith("tcp://")
+        bad = svc_mod.CheckerClient(svc.tcp_endpoint, token="wrong",
+                                    connect_timeout=2.0)
+        assert bad.ping() is False
+        bad.close()
+        good = svc_mod.CheckerClient(svc.tcp_endpoint, token="sekrit",
+                                     host="hostB")
+        packs = make_packs(91, 3)
+        outs = good.check(packs)
+        assert outs is not None
+        for got, p in zip(outs, packs):
+            assert view(got) == view(wgl.check_packed(p))
+        good.close()
+        ctr = (svc.stats().get("counters") or {})
+        assert ctr.get("service.auth_rejects", 0) >= 1, ctr
+        assert ctr.get("service.host_submitted.hostB") == 3, ctr
+    finally:
+        svc.close()
+        svc_mod.reset_clients()
+
+
+def test_admission_control_busy_is_bounded(monkeypatch):
+    """A saturated service answers BUSY immediately (never a blind
+    in-queue wait), the client's retry budget is bounded, and a BUSY
+    verdict does NOT arm the reconnect cooldown — the transport is
+    healthy, the very next smaller request may be admitted."""
+    svc = svc_mod.CheckerService(tick_s=0.01, max_pending_packs=2).start()
+    release = threading.Event()
+    real = wgl.check_packed_batch
+
+    def stalled(packs, **kw):
+        assert release.wait(timeout=30.0), "test deadlocked"
+        return real(packs, **kw)
+
+    monkeypatch.setattr(wgl, "check_packed_batch", stalled)
+    hold_result = [None]
+
+    def hold():
+        c = svc_mod.CheckerClient(svc.path)
+        hold_result[0] = c.check(make_packs(81, 2))
+        c.close()
+
+    t = threading.Thread(target=hold)
+    try:
+        t.start()
+        # wait until both packs occupy the admission ledger
+        deadline = time.monotonic() + 10.0
+        while svc._pending_packs < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert svc._pending_packs == 2
+        probe = svc_mod.CheckerClient(svc.path, max_busy_retries=1)
+        assert probe.check(make_packs(82, 1)) is None  # saturated
+        # BUSY is not a transport failure: no cooldown, no fail count
+        assert probe.available() and probe.fails == 0
+        ctr = (svc.stats().get("counters") or {})
+        assert ctr.get("service.admission_rejects", 0) >= 2, ctr
+        release.set()
+        t.join(timeout=30.0)
+        assert hold_result[0] is not None  # held request completed
+        # drained: the same client is admitted now
+        outs = probe.check(make_packs(83, 1))
+        assert outs is not None
+        probe.close()
+    finally:
+        release.set()
+        svc.close()
+        svc_mod.reset_clients()
+
+
+def test_reconnect_after_service_restart(monkeypatch, tmp_path):
+    """A client that watched its service die degrades (None -> caller
+    falls back), arms a capped-backoff cooldown instead of latching,
+    and re-promotes automatically once the service is back — counting
+    service.reconnects."""
+    monkeypatch.setattr(svc_mod, "RETRY_BASE_S", 0.05)
+    monkeypatch.setattr(svc_mod, "RETRY_CAP_S", 0.1)
+    path = str(tmp_path / "svc.sock")
+    pack = make_packs(101, 1)[0]
+    want = view(wgl.check_packed(pack))
+    svc = svc_mod.CheckerService(path=path, tick_s=0.01).start()
+    client = svc_mod.CheckerClient(path)
+    tel = Telemetry()
+    prev = telemetry.current()
+    telemetry.set_current(tel)
+    try:
+        outs = client.check([pack])
+        assert outs is not None and view(outs[0]) == want
+        svc.close()
+        assert client.check([pack]) is None  # dead: degrade, arm cooldown
+        assert client.broken and client.fails >= 1
+        svc2 = svc_mod.CheckerService(path=path, tick_s=0.01).start()
+        try:
+            deadline = time.monotonic() + 5.0
+            outs = None
+            while outs is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+                outs = client.check([pack])
+            assert outs is not None and view(outs[0]) == want
+            assert client.fails == 0 and not client.broken
+        finally:
+            svc2.close()
+    finally:
+        telemetry.set_current(prev if prev is not telemetry.NULL else None)
+        client.close()
+        svc_mod.reset_clients()
+    ctr = (tel.summary().get("counters") or {})
+    assert ctr.get("service.reconnects", 0) >= 1, ctr
+
+
+def test_version_mismatch_mid_stream_keeps_connection(service):
+    """A frame whose pack blob claims an unknown wire version is
+    answered with a structured error — and the SAME connection then
+    serves a good check: per-request degradation, not a poisoned
+    stream."""
+    pack = make_packs(111, 1)[0]
+    good = wgl.serialize_packed(pack)
+    head, _, blobs = good.partition(b"\n")
+    h = json.loads(head)
+    h["v"] = 99
+    bad = json.dumps(h).encode() + b"\n" + blobs
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(service.path)
+    s.settimeout(10.0)
+    r = transport.FrameReader(s)
+
+    def rpc(head_obj, body=b""):
+        transport.send_frame(
+            s, json.dumps(head_obj).encode() + b"\n" + body)
+        while True:
+            fr = r.recv_frame()
+            assert fr is not None, "service closed the connection"
+            resp = json.loads(fr.decode())
+            if "heartbeat" in resp:
+                continue
+            return resp
+
+    try:
+        resp = rpc({"op": "check", "sizes": [len(bad)], "id": 1}, bad)
+        assert resp["id"] == 1 and resp.get("error"), resp
+        resp = rpc({"op": "check", "sizes": [len(good)], "id": 2}, good)
+        assert resp["id"] == 2 and resp.get("results"), resp
+        assert view(resp["results"][0]) == view(wgl.check_packed(pack))
+    finally:
+        s.close()
+    ctr = (service.stats().get("counters") or {})
+    assert ctr.get("service.bad_requests") == 1, ctr
+
+
+def test_shutdown_counts_leaked_threads():
+    """A thread that outlives the join grace is a ledger entry
+    (service.shutdown_leaked_threads, stats field), not a silently
+    discarded join result."""
+    svc = svc_mod.CheckerService(tick_s=0.01, shutdown_join_s=0.1).start()
+    release = threading.Event()
+    hung = threading.Thread(target=release.wait, name="wedged-worker",
+                            daemon=True)
+    hung.start()
+    with svc._cv:
+        svc._threads.append(hung)
+    try:
+        svc.close()
+        assert svc.shutdown_leaked_threads >= 1
+        st = svc.stats()
+        assert st["shutdown_leaked_threads"] >= 1
+        ctr = (st.get("counters") or {})
+        assert ctr.get("service.shutdown_leaked_threads", 0) >= 1, ctr
+    finally:
+        release.set()
+        hung.join(timeout=5.0)
+        svc_mod.reset_clients()
 
 
 def test_service_survives_checker_exception(service, monkeypatch):

@@ -1,0 +1,357 @@
+"""Multi-host checker fleet e2e (runner/host_agent.py + the TCP
+checker service): the ISSUE 16 acceptance bars.
+
+- A 2-host campaign in CI: separate worker-agent processes over
+  loopback TCP, every run checked via the driver host's service, and
+  the shipped==submitted ledger balancing ACROSS hosts — per host and
+  in total — with verdict bit-identity vs in-process re-checks.
+- The fleet surviving its own medicine: host<->service traffic routed
+  through the net/ proxy plane under partitions, latency, lossy links
+  and slow-close, with every check either retried to success or
+  gracefully degraded (None -> local fallback), verdicts bit-identical
+  throughout, and no permanent client latch.
+- Agent death re-queues specs (capped), stranded specs run inline:
+  a campaign always completes.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from jepsen_etcd_tpu.net.plane import NetPlane
+from jepsen_etcd_tpu.ops import wgl
+from jepsen_etcd_tpu.runner import checker_service as svc_mod
+from jepsen_etcd_tpu.runner import telemetry, transport
+from jepsen_etcd_tpu.runner.campaign import campaign_specs, run_campaign
+from jepsen_etcd_tpu.runner.host_agent import HostAgentPool
+from jepsen_etcd_tpu.runner.telemetry import Telemetry
+
+from test_campaign import PROJECTION, _recheck_locally
+from test_checker_service import make_packs, view
+
+
+# -- the 2-host campaign acceptance bar --------------------------------------
+
+def _assert_cross_host_ledger(summary, hosts):
+    """The shipped==submitted identity, extended across hosts: rows'
+    producer-side fold per host == the service's consumer-side
+    service.host_submitted.<host> series, and the totals balance."""
+    rows = summary["runs"]
+    ctr = (summary["telemetry"].get("counters") or {})
+    by_host = summary["hosts"]
+    assert by_host is not None and set(by_host) == set(hosts), by_host
+    assert {r["host"] for r in rows} == set(hosts)
+    submitted = ctr.get("service.submitted", 0)
+    assert submitted >= len(rows), ctr  # every run shipped >= 1 pack
+    total_shipped = 0
+    for h in hosts:
+        st = by_host[h]
+        assert st["runs"] == sum(1 for r in rows if r["host"] == h)
+        assert st["shipped"] == sum(r["service_shipped"] for r in rows
+                                    if r["host"] == h)
+        assert st["shipped"] == ctr.get(
+            "service.host_submitted." + h), (h, st, ctr)
+        total_shipped += st["shipped"]
+    assert total_shipped == submitted, (total_shipped, ctr)
+    assert not ctr.get("service.fallback"), ctr
+
+
+def test_two_host_campaign_cross_host_ledger(tmp_path):
+    """ISSUE 16 acceptance: a campaign fanned across two worker-agent
+    processes (loopback TCP), every run checking via the driver's TCP
+    service with the campaign's shared-secret token — cross-host
+    ledger balanced, verdicts bit-identical to in-process re-checks."""
+    base = {"time_limit": 1, "rate": 100.0, "force_kernel": True,
+            "nodes": ["n1", "n2", "n3"]}
+    specs = campaign_specs(base, ["register"], [[]],
+                           runs_per_cell=8, seed0=200)
+    summary = run_campaign(specs, pool=0, service=True,
+                           service_tick_s=0.05,
+                           hosts=["hostA", "hostB"],
+                           store_base=str(tmp_path), name="fleet")
+    assert summary["valid?"] is True, summary["failures"]
+    rows = summary["runs"]
+    assert len(rows) == 8
+    assert all(r["status"] == "done" and r["valid"] is True
+               for r in rows)
+    ctr = (summary["telemetry"].get("counters") or {})
+    assert ctr.get("campaign.hosts") == 2, ctr
+    # both hosts actually worked (the queue is shared, the split need
+    # not be even — but neither agent may starve completely)
+    assert all(summary["hosts"][h]["runs"] >= 1
+               for h in ("hostA", "hostB")), summary["hosts"]
+    assert summary["agent_requeues"] == 0
+    _assert_cross_host_ledger(summary, ["hostA", "hostB"])
+    # verdict bit-identity: what the remote host shipped through the
+    # service == what this process computes from the stored history
+    for r in rows:
+        stored = json.load(
+            open(os.path.join(r["dir"], "results.json")))
+        got = {str(k): {f: (v.get("linear") or {}).get(f)
+                        for f in PROJECTION}
+               for k, v in stored["workload"]["results"].items()}
+        assert got == _recheck_locally(r["dir"]), r["dir"]
+    # the aggregate dashboard renders the cross-host ledger join
+    from jepsen_etcd_tpu.serve import aggregate_html
+    page = aggregate_html(str(tmp_path))
+    assert "ledger" in page and "balanced" in page, "hosts column missing"
+
+
+# -- the fleet under its own faults ------------------------------------------
+
+def test_fleet_survives_net_faults_through_proxy(monkeypatch):
+    """Route host->service traffic through the net/ proxy plane and
+    inject the SUT's own fault vocabulary: partition, latency+jitter,
+    slow-close, lossy link. Every check either succeeds with a
+    bit-identical verdict or degrades to None (the caller's local
+    fallback) — fast, never a 600s blind wait — and the client always
+    re-promotes after heal (no permanent latch)."""
+    monkeypatch.setattr(svc_mod, "RETRY_BASE_S", 0.05)
+    monkeypatch.setattr(svc_mod, "RETRY_CAP_S", 0.2)
+    svc = svc_mod.CheckerService(tick_s=0.01, tcp=True,
+                                 auth_token="tok",
+                                 heartbeat_s=0.25).start()
+    plane = NetPlane(seed=3)
+    tel = Telemetry()
+    prev = telemetry.current()
+    telemetry.set_current(tel)
+    client = None
+    try:
+        _, port = transport.parse_tcp(svc.tcp_endpoint)
+        ep = plane.front_service(port)
+        # idle_timeout >> heartbeat_s: silence means dead, not slow
+        client = svc_mod.CheckerClient(ep, token="tok", host="hostB",
+                                       connect_timeout=2.0,
+                                       idle_timeout=1.5, timeout=60.0)
+        packs = make_packs(301, 3, info_rate=0.2)
+        want = [view(wgl.check_packed(p)) for p in packs]
+
+        def check_ok():
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                outs = client.check(packs)
+                if outs is not None:
+                    return outs
+                time.sleep(0.05)  # cooldown armed: wait it out
+            raise AssertionError("client never re-promoted")
+
+        # baseline through the proxy: bit-identical
+        assert [view(o) for o in check_ok()] == want
+
+        # partition hostB <-> svc: degrade FAST (idle timeout, not the
+        # 600s request ceiling), cooldown armed, then heal + re-promote
+        plane.partition_pairs({frozenset(("hostB", "svc"))})
+        t0 = time.monotonic()
+        assert client.check(packs) is None
+        assert time.monotonic() - t0 < 30.0, "degradation took too long"
+        assert client.broken
+        plane.heal_partition()
+        assert [view(o) for o in check_ok()] == want
+        assert not client.broken
+
+        # latency + jitter and slow-close: slow but correct
+        plane.set_latency(30, 10)
+        plane.set_slow_close(0.2)
+        assert [view(o) for o in check_ok()] == want
+        plane.heal()
+
+        # fully lossy link: degrade; clear: recover
+        plane.set_drop_prob(1.0)
+        assert client.check(packs) is None
+        plane.clear_drop_prob()
+        assert [view(o) for o in check_ok()] == want
+    finally:
+        telemetry.set_current(prev if prev is not telemetry.NULL
+                              else None)
+        if client is not None:
+            client.close()
+        plane.close()
+        svc.close()
+        svc_mod.reset_clients()
+    # the client reconnected (counted) rather than latching broken
+    cctr = (tel.summary().get("counters") or {})
+    assert cctr.get("service.reconnects", 0) >= 1, cctr
+    # every successful check's packs attributed to hostB's ledger row
+    sctr = (svc.stats().get("counters") or {})
+    assert sctr.get("service.host_submitted.hostB") \
+        == sctr.get("service.submitted"), sctr
+
+
+def test_degraded_start_heals_mid_campaign(tmp_path, monkeypatch):
+    """Satellite: a campaign that starts with its configured service
+    DOWN checks in-process (graceful), then re-promotes mid-campaign
+    once the service comes up — later runs ship packs, the ledger
+    balances, and every verdict is bit-identical to a re-check."""
+    monkeypatch.setattr(svc_mod, "RETRY_BASE_S", 0.02)
+    monkeypatch.setattr(svc_mod, "RETRY_CAP_S", 0.05)
+    svc_mod.reset_clients()
+    path = str(tmp_path / "late-svc.sock")
+    base = {"time_limit": 1, "rate": 100.0, "force_kernel": True,
+            "nodes": ["n1", "n2", "n3"],
+            "checker_service": path}  # configured, not yet listening
+    # seed0=100: the coalescing test verified seeds 100.. all land
+    # >=1 ok op per f (a zero-op seed honestly reports "unknown",
+    # which would fail the expected-pass contract for other reasons)
+    specs = campaign_specs(base, ["register"], [[]],
+                           runs_per_cell=6, seed0=100)
+    state = {"svc": None}
+    lock = threading.Lock()
+
+    def heal_after_two(row):
+        with lock:
+            if state["svc"] is None and row["index"] >= 1:
+                state["svc"] = svc_mod.CheckerService(
+                    path=path, tick_s=0.01).start()
+
+    try:
+        summary = run_campaign(specs, pool=0, service=False,
+                               store_base=str(tmp_path), name="heal",
+                               on_row=heal_after_two)
+    finally:
+        if state["svc"] is not None:
+            state["svc"].close()
+        svc_mod.reset_clients()
+    assert state["svc"] is not None, "service never started"
+    assert summary["valid?"] is True, summary["failures"]
+    rows = summary["runs"]
+    assert len(rows) == 6
+    # phase 1 (service down): graceful in-process fallback, no errors
+    assert rows[0]["service_shipped"] == 0
+    assert rows[0]["service_fallbacks"] >= 1
+    # phase 2 (service up): the negative cache EXPIRED — later runs
+    # ship packs again instead of latching local forever
+    assert any(r["service_shipped"] > 0 for r in rows[2:]), rows
+    # producer-side ledger balances against what the late service saw
+    svc_ctr = (state["svc"].stats().get("counters") or {})
+    assert sum(r["service_shipped"] for r in rows) \
+        == svc_ctr.get("service.submitted", 0), (rows, svc_ctr)
+    for r in rows:
+        stored = json.load(
+            open(os.path.join(r["dir"], "results.json")))
+        got = {str(k): {f: (v.get("linear") or {}).get(f)
+                        for f in PROJECTION}
+               for k, v in stored["workload"]["results"].items()}
+        assert got == _recheck_locally(r["dir"]), r["dir"]
+
+
+# -- agent pool unit-level robustness ----------------------------------------
+
+def _fake_agent(endpoint, host, token, died):
+    """Hand-rolled worker agent that speaks the registration protocol,
+    accepts exactly ONE run frame, then dies mid-run (no row)."""
+    sock = transport.connect(endpoint, timeout=5.0)
+    try:
+        transport.send_preamble(sock, host)
+        transport.send_frame(sock, json.dumps(
+            {"op": "register", "host": host, "token": token}).encode())
+        reader = transport.FrameReader(sock)
+        ok = json.loads(reader.recv_frame())
+        assert ok.get("ok"), ok
+        frame = reader.recv_frame()  # the run spec arrives...
+        assert json.loads(frame).get("op") == "run"
+    finally:
+        sock.close()  # ...and the agent drops dead mid-run
+        died.set()
+
+
+def test_agent_death_requeues_then_runs_inline(tmp_path):
+    """An agent dying mid-run re-queues the spec; with no surviving
+    agents the driver runs it inline — the campaign still completes,
+    and the requeue is on the ledger."""
+    tel = Telemetry()
+    pool = HostAgentPool(token="tok", tel=tel, idle_timeout=2.0).start()
+    died = threading.Event()
+    t = threading.Thread(target=_fake_agent,
+                         args=(pool.endpoint, "flaky", "tok", died))
+    t.start()
+    try:
+        assert pool.wait_ready(1, timeout=10.0) == 1
+        assert pool.hosts() == ["flaky"]
+        spec = {"index": 0,
+                "opts": {"workload": "register", "time_limit": 1,
+                         "rate": 100.0, "seed": 5,
+                         "nodes": ["n1", "n2", "n3"],
+                         "store_base": str(tmp_path)}}
+        rows = []
+        pool.run([spec], rows.append)
+        t.join(timeout=10.0)
+        assert died.is_set()
+        assert pool.requeues >= 1
+        assert len(rows) == 1, "stranded spec never completed"
+        assert rows[0]["status"] == "done" and rows[0]["valid"] is True
+        ctr = (tel.summary().get("counters") or {})
+        assert ctr.get("campaign.agent_requeues", 0) >= 1, ctr
+    finally:
+        pool.close()
+
+
+def test_agent_pool_zero_agents_runs_inline(tmp_path):
+    """A fleet of zero registered agents degrades to the serial
+    baseline: every spec runs inline in the driver."""
+    pool = HostAgentPool().start()
+    try:
+        spec = {"index": 0,
+                "opts": {"workload": "register", "time_limit": 1,
+                         "rate": 100.0, "seed": 9,
+                         "nodes": ["n1", "n2", "n3"],
+                         "store_base": str(tmp_path)}}
+        rows = []
+        pool.run([spec], rows.append)
+        assert len(rows) == 1
+        assert rows[0]["status"] == "done"
+    finally:
+        pool.close()
+
+
+def test_agent_pool_rejects_bad_token():
+    """An agent with the wrong shared secret never joins the fleet."""
+    pool = HostAgentPool(token="right").start()
+    try:
+        sock = transport.connect(pool.endpoint, timeout=5.0)
+        try:
+            transport.send_preamble(sock, "evil")
+            transport.send_frame(sock, json.dumps(
+                {"op": "register", "host": "evil",
+                 "token": "wrong"}).encode())
+            reader = transport.FrameReader(sock)
+            sock.settimeout(5.0)
+            resp = json.loads(reader.recv_frame())
+            assert resp.get("error"), resp
+        finally:
+            sock.close()
+        assert pool.wait_ready(1, timeout=0.5) == 0
+        assert pool.hosts() == []
+    finally:
+        pool.close()
+
+
+# -- multi-process TCP soak (slow tier) --------------------------------------
+
+@pytest.mark.slow
+def test_three_host_soak(tmp_path):
+    """Larger fleet soak: 3 worker-agent processes, 24 runs, cross-host
+    ledger balanced and every verdict bit-identical."""
+    base = {"time_limit": 1, "rate": 100.0, "force_kernel": True,
+            "nodes": ["n1", "n2", "n3"]}
+    specs = campaign_specs(base, ["register"], [[], ["kill"]],
+                           runs_per_cell=12, seed0=600)
+    hosts = ["hostA", "hostB", "hostC"]
+    summary = run_campaign(specs, pool=0, service=True,
+                           service_tick_s=0.05, hosts=hosts,
+                           store_base=str(tmp_path), name="soak")
+    assert summary["valid?"] is True, summary["failures"]
+    rows = summary["runs"]
+    assert len(rows) == 24
+    assert all(r["status"] == "done" for r in rows)
+    _assert_cross_host_ledger(summary, hosts)
+    for r in rows:
+        stored = json.load(
+            open(os.path.join(r["dir"], "results.json")))
+        got = {str(k): {f: (v.get("linear") or {}).get(f)
+                        for f in PROJECTION}
+               for k, v in stored["workload"]["results"].items()}
+        assert got == _recheck_locally(r["dir"]), r["dir"]
