@@ -1176,6 +1176,75 @@ def bench_telemetry_overhead():
             "hists": sorted((summary.get("hists") or {}).keys())}
 
 
+#: the guided-search quarry: base opts seeding the stale-read bug that
+#: only fires inside open partition windows, over a cell list that
+#: EXCLUDES the bare [] cell (which fails unconditionally under the
+#: legacy always-on injection and would trivialize the uniform arm)
+_GUIDED_BASE = {"workload": "register", "nodes": ["n1", "n2", "n3"],
+                "concurrency": 6, "rate": 100.0, "time_limit": 1.0,
+                "inject_stale_reads": True, "gen_epoch": "epoch-v2"}
+_GUIDED_CELLS = [["kill"], ["pause"], ["latency"], ["member"],
+                 ["partition"]]
+
+
+def _uniform_first_failure(specs) -> int | None:
+    """1-based index of the first failing spec in matrix order, each
+    evaluated as one single-seed batched generation + checker pass
+    (the cheap stand-in for a full uniform campaign run)."""
+    from jepsen_etcd_tpu.runner.shrink import checker_opts_from
+    from jepsen_etcd_tpu.simbatch import BatchConfig, generate
+    from jepsen_etcd_tpu.workloads import workloads
+    for i, s in enumerate(specs):
+        opts = s["opts"]
+        cfg = BatchConfig.from_opts(opts)
+        copts = checker_opts_from(opts)
+        checker = workloads()[cfg.workload](dict(copts))["checker"]
+        g = generate(cfg, [int(opts.get("seed", 0))])
+        res = checker.check(dict(copts), g["histories"][0])
+        if res.get("valid?") is not True:
+            return i + 1
+    return None
+
+
+def bench_guided_search():
+    """Robustness cell: coverage-guided search vs the uniform matrix,
+    same seeded stale-read bug, same master seed. Reports runs-to-
+    first-failure for both arms (the guided arm must not be slower
+    than HALF the uniform arm — the acceptance bar tests/test_guided.py
+    pins) plus the guided wall time and the minimized repro size."""
+    import tempfile
+    from jepsen_etcd_tpu.runner.campaign import campaign_specs
+    from jepsen_etcd_tpu.runner.guided import run_guided
+
+    specs = campaign_specs(_GUIDED_BASE, ["register"], _GUIDED_CELLS,
+                           6, 7)
+    t0 = time.time()
+    uniform_first = _uniform_first_failure(specs)
+    uniform_s = time.time() - t0
+    assert uniform_first is not None, "uniform matrix never failed"
+    t0 = time.time()
+    with tempfile.TemporaryDirectory() as td:
+        summary = run_guided(_GUIDED_BASE, ["register"], _GUIDED_CELLS,
+                             budget=12, seed0=7, pool=0, service=False,
+                             live=False, store_base=td)
+        guided_s = time.time() - t0
+        guided_first = summary["first_failure_run"]
+        mins = summary["minimized"]
+    assert guided_first is not None, "guided search never failed"
+    note(f"guided-search: uniform first failure at run {uniform_first} "
+         f"({uniform_s:.2f}s), guided at run {guided_first} "
+         f"({guided_s:.2f}s), {len(mins)} minimized repro(s)")
+    return {"value": round(uniform_first / max(guided_first, 1), 2),
+            "unit": "x_fewer_runs",
+            "uniform_first": uniform_first,
+            "guided_first": guided_first,
+            "guided_s": round(guided_s, 2),
+            "uniform_s": round(uniform_s, 2),
+            "minimized": [{"windows": m["windows"],
+                           "nemesis_ops": m["nemesis_ops"]}
+                          for m in mins]}
+
+
 CELLS = [("register_100", bench_register_100),
          ("engine_crossover", bench_engine_crossover),
          ("deep_wgl_4n_2000", bench_deep_wgl),
@@ -1193,7 +1262,8 @@ CELLS = [("register_100", bench_register_100),
          ("net_overhead", bench_net_overhead),
          ("telemetry_overhead", bench_telemetry_overhead),
          ("campaign_amortization", bench_campaign_amortization),
-         ("service_scaling", bench_service_scaling)]
+         ("service_scaling", bench_service_scaling),
+         ("guided_search", bench_guided_search)]
 
 
 # ---------------------------------------------------------------------
@@ -1547,6 +1617,62 @@ def _dry_telemetry_overhead():
             "hist_count": hists["op.latency.write"]["count"]}
 
 
+def _dry_guided_search():
+    """Guided-search structure at tiny size, no timing: (a) two
+    schedulers with the same master seed emit byte-identical candidate
+    generations (the search is a pure function of the seed), and (b) a
+    drawn fault plan, its materialized explicit schedule, and a batched
+    same-seed population all generate BIT-identical histories — the
+    determinism contract shrink's candidate re-execution rests on."""
+    import json as _json
+    from jepsen_etcd_tpu.runner.guided import GuidedScheduler
+    from jepsen_etcd_tpu.simbatch import (BatchConfig, default_schedule,
+                                          generate, history_sha)
+
+    base = dict(_GUIDED_BASE, time_limit=0.5)
+    cells = [["partition"], ["kill"]]
+    ancestor = dict(base, workload="register", nemesis=["partition"],
+                    seed=_DRY_SEED)
+    gens = []
+    for _ in range(2):
+        s = GuidedScheduler(base, ["register"], cells,
+                            seed0=_DRY_SEED, master_seed=_DRY_SEED)
+        s.corpus.append({"opts": ancestor, "seed": _DRY_SEED, "run": 1,
+                         "score": 4, "signature": "workload=False",
+                         "vector": {"frontier": 1, "rungs": 0,
+                                    "spills": 0}})
+        s.corpus.append({"opts": dict(ancestor, nemesis=["kill"],
+                                      seed=_DRY_SEED + 1),
+                         "seed": _DRY_SEED + 1, "run": 2, "score": 1,
+                         "signature": "",
+                         "vector": {"frontier": 1, "rungs": 0,
+                                    "spills": 0}})
+        gens.append([s.next_generation(6) for _ in range(3)])
+    assert _json.dumps(gens[0], sort_keys=True) == \
+        _json.dumps(gens[1], sort_keys=True), "mutation nondeterminism"
+    mutated = sum(1 for g in gens[0] for o in g
+                  if o.get("nem_schedule") or o.get("nem_drop_prob")
+                  or o.get("nem_partition_shape")
+                  or o.get("nem_latency_ms"))
+    assert mutated, "no schedule/knob mutations in 18 candidates"
+
+    cfg = BatchConfig.from_opts(ancestor)
+    drawn = generate(cfg, [_DRY_SEED])["histories"][0]
+    sched = default_schedule(cfg, _DRY_SEED)
+    explicit = generate(cfg, [_DRY_SEED],
+                        nem_schedules=[sched])["histories"][0]
+    pop = generate(cfg, [_DRY_SEED] * 3,
+                   nem_schedules=[sched] * 3)["histories"]
+    sha = history_sha(drawn)
+    assert history_sha(explicit) == sha, \
+        "materialized schedule diverges from the drawn plan"
+    assert all(history_sha(h) == sha for h in pop), \
+        "batched same-seed population diverges"
+    return {"candidates": sum(len(g) for g in gens[0]),
+            "mutated": mutated, "windows": len(sched),
+            "replay_identical": True}
+
+
 DRY_CHECKS = {"register_100": _dry_register,
               "engine_crossover": _dry_register,
               "deep_wgl_4n_2000": _dry_register,
@@ -1565,6 +1691,7 @@ DRY_CHECKS = {"register_100": _dry_register,
               "telemetry_overhead": _dry_telemetry_overhead,
               "campaign_amortization": _dry_campaign,
               "service_scaling": _dry_service_scaling,
+              "guided_search": _dry_guided_search,
               "register_10k": _dry_register}
 
 

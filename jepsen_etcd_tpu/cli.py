@@ -9,6 +9,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 
 from .compose import etcd_test, default_opts
@@ -137,6 +138,13 @@ def build_parser() -> argparse.ArgumentParser:
                             "healed after. Kinds: latency[:delta-ms], "
                             "drop[:probability], partition. Repeatable")
         s.add_argument("--test-count", type=int, default=1)
+        s.add_argument("--inject-stale-reads", action="store_true",
+                       help="seed the sim's stale-read bug class "
+                            "(epoch-v2 generator): reads may return "
+                            "the pre-last-write snapshot — with "
+                            "faults configured, only inside an open "
+                            "partition window (the guided-campaign "
+                            "target); with none, unconditionally")
         s.add_argument("--only-workloads-expected-to-pass",
                        action="store_true")
         s.add_argument("--store", default="store")
@@ -194,6 +202,21 @@ def build_parser() -> argparse.ArgumentParser:
                            "every key is device-bound (coalescing "
                            "demos/tests; production keeps the "
                            "measured routing)")
+    camp.add_argument("--guided", type=int, default=0, metavar="N",
+                      help="coverage-guided mode: spend a budget of N "
+                           "runs adaptively instead of sweeping the "
+                           "matrix uniformly — generation 0 "
+                           "stratifies one run per cell, later "
+                           "generations mutate a corpus of "
+                           "novelty-scored ancestors (runner/"
+                           "guided.py); failing schedules are "
+                           "delta-debugged to minimal repros "
+                           "(shrink.json). Forces gen-epoch epoch-v2 "
+                           "for sim specs")
+    camp.add_argument("--master-seed", type=int, default=None,
+                      help="--guided: the search RNG seed (mutation/"
+                           "crossover draws; default: --seed) — one "
+                           "master seed fully determines the search")
     cs = sub.add_parser("checker-service",
                         help="run a standalone batched TPU checker "
                              "service: one process owns the device; "
@@ -266,8 +289,22 @@ def build_parser() -> argparse.ArgumentParser:
                     help="emit the per-run + aggregate coverage "
                          "vector (frontier, rungs, spills, verdict "
                          "signatures)")
+    tl.add_argument("--corpus", action="store_true",
+                    help="inspect a guided campaign (guided.json): "
+                         "corpus ancestors, novel signatures, "
+                         "minimized repros")
     tl.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
+    rp = sub.add_parser("replay",
+                        help="re-execute a minimized repro "
+                             "(shrink.json): regenerate the history "
+                             "from the stored config + seed via the "
+                             "batched generator, re-check it, and "
+                             "verify the verdict signature matches "
+                             "(exit 1 when it does not)")
+    rp.add_argument("artifact",
+                    help="path to a shrink.json store artifact (or a "
+                         "run dir containing one)")
     return p
 
 
@@ -325,6 +362,8 @@ def opts_from_args(args) -> dict:
         "debug": args.debug,
         "tcpdump": args.tcpdump,
         "no_telemetry": getattr(args, "no_telemetry", False),
+        "inject_stale_reads": getattr(args, "inject_stale_reads",
+                                      False),
         "checker_service": getattr(args, "checker_service", None),
         "stream": getattr(args, "stream", False),
         "stream_chunk_ops": getattr(args, "stream_chunk_ops", 1024),
@@ -422,6 +461,14 @@ def main(argv=None) -> int:
         finally:
             svc.close()
         return 0
+    if args.command == "replay":
+        from .runner.shrink import replay_artifact
+        path = args.artifact
+        if os.path.isdir(path):
+            path = os.path.join(path, "shrink.json")
+        out = replay_artifact(path)
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0 if out["match"] else 1
     if args.command == "campaign":
         from .runner.campaign import campaign_specs, run_campaign
         base = opts_from_args(args)
@@ -429,6 +476,42 @@ def main(argv=None) -> int:
             base["force_kernel"] = True
         base["gen_epoch"] = args.gen_epoch
         wls, nemeses = test_all_matrix(args)
+        if args.guided:
+            from .runner.guided import run_guided
+
+            def _print_guided_row(row):
+                print(json.dumps({k: row.get(k) for k in
+                                  ("index", "workload", "nemesis",
+                                   "seed", "status", "valid", "dir")}))
+
+            out = run_guided(
+                base, wls, nemeses, budget=args.guided,
+                seed0=args.seed, master_seed=args.master_seed,
+                pool=args.pool,
+                service=not args.no_service and not base.get(
+                    "checker_service"),
+                service_tick_s=args.service_tick,
+                store_base=args.store,
+                name=args.campaign_name
+                if args.campaign_name != "campaign" else "guided",
+                live=not args.no_live, hosts=args.hosts or None,
+                on_row=_print_guided_row)
+            print(json.dumps({
+                "guided": out["name"], "dir": out["dir"],
+                "budget": out["budget"], "runs": out["runs"],
+                "generations": out["generations"],
+                "signatures": out["signatures"],
+                "first_failure_run": out["first_failure_run"],
+                "corpus": len(out["corpus"]),
+                "minimized": [{k: m.get(k) for k in
+                               ("dir", "signature", "windows",
+                                "nemesis_ops", "repro")}
+                              for m in out["minimized"]],
+                "wall_s": out["wall_s"],
+            }))
+            # a guided campaign EXISTS to find failures: exit 0 means
+            # the search completed, not that every run passed
+            return 0
         specs = campaign_specs(base, wls, nemeses,
                                runs_per_cell=args.test_count,
                                seed0=args.seed)

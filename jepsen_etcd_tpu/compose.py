@@ -105,6 +105,26 @@ def default_opts() -> dict:
                                         # epoch-v2 routes campaign sim
                                         # runs through the batched
                                         # lockstep generator (simbatch/)
+        "inject_stale_reads": False,    # seed a stale-read serving bug
+                                        # in the sim (guided-campaign
+                                        # quarry; with nemeses present
+                                        # it only fires inside open
+                                        # partition windows)
+        "nem_schedule": None,           # explicit nemesis schedule:
+                                        # [[start_ns, kind, hold_ns],
+                                        # ...] replayed verbatim instead
+                                        # of the drawn fault plan
+                                        # (shrink repros, guided window
+                                        # mutations)
+        "nem_partition_shape": None,    # partition grudge override
+                                        # (majority | primaries | ...);
+                                        # None keeps the drawn shape
+        "nem_latency_ms": None,         # latency-fault delta override
+                                        # in ms; scales the latency
+                                        # window timeout probability
+        "nem_drop_prob": 0.0,           # extra flat drop probability
+                                        # added inside every open fault
+                                        # window
     }
 
 

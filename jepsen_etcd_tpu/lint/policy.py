@@ -67,6 +67,13 @@ DET_WALLCLOCK_ALLOW = (
                                   # heartbeat/requeue timing (THR
                                   # still applies to its drive and
                                   # beat threads)
+    "runner/guided.py",          # campaign-wave orchestration: wall
+                                 # time is summary accounting only
+                                 # (scores come from coverage vectors,
+                                 # never the clock)
+    "runner/shrink.py",          # artifact mtimes/summary wall only;
+                                 # acceptance is signature equality on
+                                 # replayed deterministic histories
     "db/local.py",
     "db/fake_etcd.py",
     "net/*",            # userspace proxy plane: socket splice loops

@@ -613,10 +613,15 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
         # workload, stream/soak, epoch-v1) takes the pool as before.
         cells: dict = {}
         pooled = []
+        if any(_batchable(s["opts"]) for s in run_specs):
+            from ..simbatch import BatchConfig
         for s in run_specs:
             if _batchable(s["opts"]):
-                key = (s["opts"].get("workload"),
-                       tuple(s["opts"].get("nemesis") or ()))
+                # the full config identity, not just (workload,
+                # nemesis): guided mutants perturb schedules/knobs
+                # inside one matrix cell and must not be coalesced
+                # into a neighbour's generate() call
+                key = BatchConfig.from_opts(s["opts"]).cache_key()
                 cells.setdefault(key, []).append(s)
             else:
                 pooled.append(s)

@@ -1,0 +1,382 @@
+"""Coverage-guided fault campaigns: an evolutionary scheduler over the
+test-all matrix.
+
+The uniform campaign samples workload × nemesis × seed cells blindly;
+this driver spends the same run budget adaptively. Generation 0
+stratifies one run per matrix cell (``campaign_specs`` with
+``runs_per_cell=1`` — every cell is novel by definition), then each
+later generation mutates/crosses over a corpus of *interesting*
+ancestors:
+
+- **Scoring** reuses ``tel_cli.coverage``'s per-run feature vector
+  verbatim (verdict signature + peak frontier width, rung escalations,
+  host spills). A run earns corpus membership by showing a NEW verdict
+  signature, pushing a feature dimension outside the seen envelope, or
+  visiting an unseen cell. Infrastructure errors (no checker verdict)
+  score zero — guided search never chases harness noise.
+- **Mutations** act on the explicit nemesis schedule (materialized via
+  ``simbatch.default_schedule`` when a run carried only drawn cycles):
+  add/remove/retime windows, swap the partition shape, perturb the
+  drop-probability/latency knobs, reseed, or cross over two ancestors
+  (workload+seed from one, fault plan from the other). All draws come
+  from ONE campaign-seeded ``np.random.default_rng`` so a master seed
+  fully determines the search.
+- **Execution** is the existing fleet, unchanged: each generation is
+  one ``run_campaign`` wave (pool / host agents / checker service all
+  apply), nested under the guided store dir as ``gen0, gen1, ...``.
+
+Every failing run whose signature is newly seen is handed to
+``runner/shrink.py``; the minimized schedule lands as ``shrink.json``
+in that run's store dir. The driver's own summary — corpus, novel
+signatures, per-run ledger, minimized repros — is ``guided.json``,
+surfaced by ``/aggregate`` and ``tel --corpus``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..simbatch import BatchConfig, default_schedule, schedule_span
+from .campaign import _batchable, campaign_specs, run_campaign
+from .shrink import shrink_run
+from .store import _scrub, link_latest, make_store_dir
+from .telemetry import Telemetry
+
+#: partition start values guided can swap in (nemesis/faults.py shapes)
+PARTITION_SHAPES = ("majority", "primaries", "majorities-ring",
+                    "bridge", "one-way")
+#: drop-probability / latency-delta pools for knob perturbation
+DROP_PROBS = (0.01, 0.05, 0.1)
+LATENCIES_MS = (8.0, 16.0, 32.0, 64.0, 128.0)
+
+#: corpus size cap: lowest-scoring ancestors fall off first
+CORPUS_CAP = 32
+
+#: feature-vector dimensions folded into the novelty envelope (the
+#: tel_cli.coverage vector keys, reused verbatim)
+ENVELOPE_DIMS = ("frontier", "rungs", "spills")
+
+
+def _copy_opts(opts: dict) -> dict:
+    return json.loads(json.dumps(_scrub(opts)))
+
+
+class GuidedScheduler:
+    """Deterministic candidate source: stratified seeding, then
+    corpus-driven mutation/crossover. Pure bookkeeping — it never runs
+    anything, so unit tests can pin its output spec-for-spec."""
+
+    def __init__(self, base_opts: dict, workloads: list, nemeses: list,
+                 *, seed0: int = 0, master_seed: Optional[int] = None,
+                 corpus_cap: int = CORPUS_CAP):
+        self.base = _copy_opts(base_opts)
+        self.workloads = list(workloads)
+        self.nemeses = [list(n) for n in nemeses]
+        self.master_seed = int(seed0 if master_seed is None
+                               else master_seed)
+        self.rng = np.random.default_rng(self.master_seed)
+        self._pending = [s["opts"] for s in campaign_specs(
+            self.base, self.workloads, self.nemeses, 1, seed0)]
+        self.next_seed = seed0 + len(self._pending)
+        self.corpus: list[dict] = []
+        self.corpus_cap = corpus_cap
+        self.seen_signatures: dict[str, int] = {}
+        self.seen_cells: set = set()
+        self.envelope = {dim: 0 for dim in ENVELOPE_DIMS}
+        self.runs_observed = 0
+        self.mutations = 0
+        self.crossovers = 0
+
+    # -- candidate generation ----------------------------------------
+
+    def next_generation(self, size: int) -> list:
+        """Up to ``size`` opts dicts: pending stratified cells first,
+        then mutants/crossovers of corpus ancestors."""
+        out = []
+        while self._pending and len(out) < size:
+            out.append(self._pending.pop(0))
+        while len(out) < size:
+            out.append(self._mutate())
+        return out
+
+    def _mint_seed(self) -> int:
+        s = self.next_seed
+        self.next_seed += 1
+        return s
+
+    def _random_cell(self) -> dict:
+        rng = self.rng
+        wl = self.workloads[int(rng.integers(len(self.workloads)))]
+        nem = self.nemeses[int(rng.integers(len(self.nemeses)))]
+        opts = dict(self.base)
+        opts.update({"workload": wl, "nemesis": list(nem),
+                     "seed": self._mint_seed()})
+        return opts
+
+    def _pick(self) -> dict:
+        return self.corpus[int(self.rng.integers(len(self.corpus)))]
+
+    def _mutate(self) -> dict:
+        rng = self.rng
+        self.mutations += 1
+        if not self.corpus:
+            return self._random_cell()
+        if len(self.corpus) >= 2 and rng.random() < 0.25:
+            return self._crossover()
+        anc = self._pick()
+        opts = _copy_opts(anc["opts"])
+        nem = list(opts.get("nemesis") or ())
+        ops = ["reseed", "cell"]
+        if nem and _batchable(opts):
+            ops += ["window"] * 3
+            if "partition" in nem:
+                ops.append("shape")
+            ops.append("knob")
+        op = ops[int(rng.integers(len(ops)))]
+        if op == "reseed":
+            opts["seed"] = self._mint_seed()
+        elif op == "cell":
+            nem2 = self.nemeses[int(rng.integers(len(self.nemeses)))]
+            opts["nemesis"] = list(nem2)
+            opts.pop("nem_schedule", None)  # kinds may no longer match
+            opts["seed"] = self._mint_seed()
+        elif op == "window":
+            self._mutate_schedule(opts)
+        elif op == "shape":
+            opts["nem_partition_shape"] = str(
+                PARTITION_SHAPES[int(rng.integers(
+                    len(PARTITION_SHAPES)))])
+        elif op == "knob":
+            if rng.random() < 0.5:
+                opts["nem_drop_prob"] = float(
+                    DROP_PROBS[int(rng.integers(len(DROP_PROBS)))])
+            else:
+                opts["nem_latency_ms"] = float(
+                    LATENCIES_MS[int(rng.integers(len(LATENCIES_MS)))])
+        return opts
+
+    def _materialize(self, opts: dict) -> list:
+        """The explicit window list a mutant starts from: the opts' own
+        schedule, else the drawn cycles of (config, seed)."""
+        sched = opts.get("nem_schedule")
+        if sched is None:
+            cfg = BatchConfig.from_opts(opts)
+            sched = default_schedule(cfg, int(opts.get("seed", 0)))
+        return [list(w) for w in sched]
+
+    def _mutate_schedule(self, opts: dict) -> None:
+        rng = self.rng
+        sched = self._materialize(opts)
+        span = schedule_span(BatchConfig.from_opts(opts))
+        kinds = list(opts.get("nemesis") or ())
+        which = rng.random()
+        if which < 0.4 or not sched:  # add a window
+            start = int(rng.integers(1, max(2, span)))
+            hold = int(rng.integers(max(1, span // 12),
+                                    max(2, span // 4)))
+            kind = kinds[int(rng.integers(len(kinds)))]
+            sched.append([start, kind, hold])
+        elif which < 0.7:  # drop a window
+            sched.pop(int(rng.integers(len(sched))))
+        else:  # retime a window
+            w = sched[int(rng.integers(len(sched)))]
+            if rng.random() < 0.5:
+                w[0] = max(1, int(w[0] * rng.uniform(0.5, 1.5)))
+            else:
+                w[2] = max(1, int(w[2] * rng.uniform(0.5, 1.5)))
+        sched.sort(key=lambda w: (w[0], w[2]))
+        opts["nem_schedule"] = sched
+
+    def _crossover(self) -> dict:
+        """Workload+seed from one ancestor, fault plan (nemesis list,
+        schedule, knobs) from another."""
+        self.crossovers += 1
+        a, b = self._pick(), self._pick()
+        opts = _copy_opts(a["opts"])
+        donor = _copy_opts(b["opts"])
+        opts["nemesis"] = list(donor.get("nemesis") or ())
+        for k in ("nem_schedule", "nem_partition_shape",
+                  "nem_latency_ms", "nem_drop_prob"):
+            if donor.get(k) is not None:
+                opts[k] = donor[k]
+            else:
+                opts.pop(k, None)
+        return opts
+
+    # -- scoring ------------------------------------------------------
+
+    def observe(self, opts: dict, row: dict,
+                vector: Optional[dict]) -> int:
+        """Score one finished run by coverage novelty; admit scoring
+        runs to the corpus. Returns the score (0 = not interesting).
+
+        Rows without a real checker verdict (agent errors, requeues,
+        crashed epilogues) always score 0: harness noise must not
+        steer the search."""
+        self.runs_observed += 1
+        cell = (row.get("workload"), tuple(row.get("nemesis") or ()))
+        if row.get("status") != "done" or not vector:
+            return 0
+        score = 0
+        sig = vector.get("signature") or ""
+        if sig and sig not in self.seen_signatures:
+            self.seen_signatures[sig] = self.runs_observed
+            score += 4
+        for dim in ENVELOPE_DIMS:
+            v = int(vector.get(dim) or 0)
+            if v > self.envelope[dim]:
+                self.envelope[dim] = v
+                score += 1
+        if cell not in self.seen_cells:
+            self.seen_cells.add(cell)
+            score += 1
+        if score:
+            self.corpus.append({
+                "opts": _copy_opts(opts), "seed": row.get("seed"),
+                "run": self.runs_observed, "score": score,
+                "signature": sig,
+                "vector": {dim: int(vector.get(dim) or 0)
+                           for dim in ENVELOPE_DIMS},
+            })
+            if len(self.corpus) > self.corpus_cap:
+                self.corpus.sort(key=lambda c: (-c["score"], c["run"]))
+                del self.corpus[self.corpus_cap:]
+        return score
+
+
+def run_guided(base_opts: dict, workloads: list, nemeses: list, *,
+               budget: int, seed0: int = 0,
+               master_seed: Optional[int] = None,
+               pool: int = 0, service: bool = False,
+               service_tick_s: float = 0.05,
+               store_base: str = "store", name: str = "guided",
+               start_method: str = "spawn", live: bool = False,
+               hosts=None, shrink: bool = True, max_shrinks: int = 4,
+               gen_size: Optional[int] = None, on_row=None) -> dict:
+    """Drive a guided campaign of ``budget`` runs; returns (and writes
+    as ``<guided dir>/guided.json``) the search summary.
+
+    Each generation executes as one :func:`run_campaign` wave nested
+    under the guided store dir, so the pool / checker-service /
+    host-agent fleet applies unchanged. Batched re-execution wants the
+    lockstep generator, so ``gen_epoch`` defaults to epoch-v2 here."""
+    from ..tel_cli import coverage
+
+    t0 = time.monotonic()
+    base = _copy_opts(base_opts)
+    base.setdefault("gen_epoch", "epoch-v2")
+    gdir = make_store_dir(store_base, name)
+    trace = f"{name}-{os.path.basename(gdir)}"
+    tel = Telemetry(os.path.join(gdir, "telemetry.jsonl"), trace=trace)
+    sched = GuidedScheduler(base, workloads, nemeses, seed0=seed0,
+                            master_seed=master_seed)
+    ledger: list[dict] = []
+    minimized: list[dict] = []
+    first_failure: Optional[int] = None
+    gen = 0
+    runs_left = int(budget)
+    try:
+        while runs_left > 0:
+            want = min(runs_left,
+                       len(sched._pending) or gen_size
+                       or max(2, len(sched._pending) or 4))
+            specs = [{"index": i, "opts": o} for i, o in
+                     enumerate(sched.next_generation(want))]
+            if not specs:
+                break
+            tel.counter("guided.generations")
+            tel.event("guided.generation", gen=gen, size=len(specs))
+            summary = run_campaign(
+                specs, pool=pool, service=service,
+                service_tick_s=service_tick_s, store_base=gdir,
+                name=f"gen{gen}", start_method=start_method,
+                live=live, hosts=hosts, on_row=on_row)
+            for row in sorted((r for r in summary["runs"] if r),
+                              key=lambda r: r["index"]):
+                opts = specs[row["index"]]["opts"]
+                rdir = row.get("dir")
+                vector = None
+                if rdir:
+                    try:
+                        cov = coverage(rdir)
+                        vector = (cov["runs"] or [None])[0]
+                    except Exception:
+                        vector = None
+                score = sched.observe(opts, row, vector)
+                tel.counter("guided.runs")
+                if row.get("status") != "done":
+                    tel.counter("guided.errors")
+                if score:
+                    tel.counter("guided.novelty", score)
+                sig = (vector or {}).get("signature") or ""
+                failing = (row.get("status") == "done"
+                           and row.get("valid") is False)
+                if failing:
+                    tel.counter("guided.failures")
+                    if first_failure is None:
+                        first_failure = sched.runs_observed
+                ledger.append({
+                    "run": sched.runs_observed, "gen": gen,
+                    "index": row["index"],
+                    "workload": row.get("workload"),
+                    "nemesis": row.get("nemesis"),
+                    "seed": row.get("seed"),
+                    "status": row.get("status"),
+                    "valid": row.get("valid"),
+                    "signature": sig, "score": score,
+                    "dir": rdir,
+                })
+                # shrink the first run of each novel failure signature
+                if (shrink and failing and sig and rdir
+                        and len(minimized) < max_shrinks
+                        and sched.seen_signatures.get(sig)
+                        == sched.runs_observed
+                        and _batchable(opts)):
+                    try:
+                        art = shrink_run(opts, int(row.get("seed") or 0),
+                                         store_dir=rdir)
+                    except Exception:
+                        art = None
+                    if art:
+                        minimized.append({
+                            "dir": rdir, "run": sched.runs_observed,
+                            "signature": art["signature"],
+                            "original_windows": art["original_windows"],
+                            "windows": art["windows"],
+                            "nemesis_ops": art["nemesis_ops"],
+                            "executions": art["executions"],
+                            "repro": art["repro"],
+                        })
+            runs_left -= len(specs)
+            gen += 1
+        tel.counter("guided.corpus", len(sched.corpus), mode="max")
+        tel.counter("guided.mutations", sched.mutations)
+        tel.counter("guided.crossovers", sched.crossovers)
+        tel.counter("guided.signatures", len(sched.seen_signatures))
+    finally:
+        out = {
+            "schema": 1, "kind": "guided", "name": name, "dir": gdir,
+            "budget": int(budget), "runs": sched.runs_observed,
+            "generations": gen, "seed0": seed0,
+            "master_seed": sched.master_seed,
+            "workloads": list(workloads),
+            "nemeses": [list(n) for n in nemeses],
+            "signatures": dict(sched.seen_signatures),
+            "envelope": dict(sched.envelope),
+            "first_failure_run": first_failure,
+            "corpus": sched.corpus,
+            "minimized": minimized,
+            "ledger": ledger,
+            "wall_s": round(time.monotonic() - t0, 3),
+            "telemetry": tel.summary(),
+        }
+        with open(os.path.join(gdir, "guided.json"), "w") as f:
+            json.dump(_scrub(out), f, indent=2, default=repr)
+        tel.close()
+        link_latest(gdir)
+    return out

@@ -224,11 +224,32 @@ REGISTRY = {
                                   # received over the live socket
         "live.dropped",           # records shed by the bounded queue
                                   # (backpressure, never blocking)
+        "guided.generations",     # runner/guided.py search accounting:
+                                  # run_campaign waves driven
+        "guided.runs",            # runs scored by the scheduler
+        "guided.errors",          # rows without a checker verdict
+                                  # (never scored — harness noise)
+        "guided.failures",        # rows with a real failing verdict
+        "guided.novelty",         # summed novelty score admitted to
+                                  # the corpus
+        "guided.signatures",      # distinct verdict signatures seen
+        "guided.corpus",          # peak corpus size (mode=max)
+        "guided.mutations",       # mutants generated
+        "guided.crossovers",      # crossover children generated
+        "shrink.runs",            # runner/shrink.py: shrinks attempted
+        "shrink.candidates",      # candidate schedules re-executed
+        "shrink.rounds",          # ddmin rounds run
+        "shrink.accepted",        # shrinks that reduced the schedule
+        "shrink.irreproducible",  # failures that did not reproduce
+                                  # under re-execution (left unshrunk)
+        "shrink.artifacts",       # shrink.json artifacts written
     ),
     "events": (
         "telemetry.dropped",
         "campaign.run",           # one completed campaign run (attrs:
                                   # workload, nemesis, seed, valid)
+        "guided.generation",      # one guided generation dispatched
+                                  # (attrs: gen, size)
     ),
 }
 

@@ -209,6 +209,91 @@ def _campaign_rows(store_base: str) -> list[dict]:
     return rows
 
 
+def _guided_rows(store_base: str) -> list[dict]:
+    """Guided-campaign summaries under the store: every
+    ``<store>/<name>/<id>/guided.json`` written by
+    runner/guided.run_guided. Same two-level walk as
+    ``_campaign_rows`` (guided dirs carry no history.jsonl either).
+    Sorted oldest-first."""
+    rows = []
+    try:
+        names = sorted(os.listdir(store_base))
+    except OSError:
+        return rows
+    for name in names:
+        ndir = os.path.join(store_base, name)
+        if not os.path.isdir(ndir):
+            continue
+        try:
+            ids = sorted(os.listdir(ndir))
+        except OSError:
+            continue
+        for rid in ids:
+            if os.path.islink(os.path.join(ndir, rid)):
+                continue  # the `latest` convenience symlink
+            gpath = os.path.join(ndir, rid, "guided.json")
+            summary = _load_json(gpath)
+            if not isinstance(summary, dict) or \
+                    summary.get("kind") != "guided":
+                continue
+            try:
+                mtime = os.path.getmtime(gpath)
+            except OSError:
+                mtime = 0
+            rows.append({
+                "dir": os.path.relpath(os.path.dirname(gpath),
+                                       store_base),
+                "mtime": mtime,
+                "name": summary.get("name", name),
+                "budget": summary.get("budget"),
+                "runs": summary.get("runs"),
+                "generations": summary.get("generations"),
+                "signatures": summary.get("signatures") or {},
+                "first_failure_run": summary.get("first_failure_run"),
+                "corpus": len(summary.get("corpus") or []),
+                "minimized": summary.get("minimized") or [],
+                "wall_s": summary.get("wall_s"),
+            })
+    rows.sort(key=lambda r: r["mtime"])
+    return rows
+
+
+def _shrink_rows(store_base: str) -> list[dict]:
+    """Minimized-repro artifacts: every run dir carrying a
+    ``shrink.json`` written by runner/shrink.shrink_run. A full walk,
+    not forensics.all_runs — guided campaigns nest their runs one
+    level deeper (``<store>/<name>/<id>/gen<N>/<run>``) than the
+    two-level run index. Newest first."""
+    rows = []
+    for root, dirs, files in os.walk(store_base, followlinks=False):
+        dirs[:] = [d for d in dirs
+                   if not os.path.islink(os.path.join(root, d))]
+        if "shrink.json" not in files:
+            continue
+        rdir = root
+        art = _load_json(os.path.join(rdir, "shrink.json"))
+        if not isinstance(art, dict) or "signature" not in art:
+            continue
+        try:
+            mtime = os.path.getmtime(os.path.join(rdir, "shrink.json"))
+        except OSError:
+            mtime = 0
+        rows.append({
+            "dir": os.path.relpath(rdir, store_base),
+            "mtime": mtime,
+            "workload": art.get("workload"),
+            "signature": art.get("signature"),
+            "original_windows": art.get("original_windows"),
+            "windows": art.get("windows"),
+            "nemesis_ops": art.get("nemesis_ops"),
+            "rounds": art.get("rounds"),
+            "executions": art.get("executions"),
+            "repro": art.get("repro"),
+        })
+    rows.sort(key=lambda r: r["mtime"], reverse=True)
+    return rows
+
+
 def _host_ledger(summary: dict, sctr: dict) -> dict | None:
     """Per-host attribution for a multi-host campaign: the rows' fold
     (runs + shipped per host, producer side) joined with the service's
@@ -502,16 +587,86 @@ def aggregate_html(store_base: str) -> str:
                 f"{chips_td}{hosts_td}</tr>")
         out.append("</table>")
 
+    # -- guided campaigns ----------------------------------------------------
+    guided = _guided_rows(store_base)
+    if guided:
+        out.append(
+            "<h2>Guided campaigns</h2>"
+            "<p class='dim'>coverage-guided fault search "
+            "(campaign --guided N) — novelty-scored corpus evolution; "
+            "first failing run and distinct verdict signatures per "
+            "budget</p>"
+            "<table><tr><th>campaign</th><th>time</th><th>budget</th>"
+            "<th>runs</th><th>gens</th><th>first failure</th>"
+            "<th>signatures</th><th>corpus</th><th>minimized</th>"
+            "<th>wall</th></tr>")
+        for g in guided:
+            when = time.strftime("%Y-%m-%d %H:%M",
+                                 time.localtime(g["mtime"]))
+            ff = g["first_failure_run"]
+            ff_td = (f"<td>run {ff}</td>" if isinstance(ff, int)
+                     else "<td class='dim'>none</td>")
+            sigs = g["signatures"]
+            sig_td = (
+                "<td title='"
+                + html.escape("; ".join(
+                    f"{s} @ run {r}" for s, r in sorted(sigs.items())))
+                + f"'>{len(sigs)}</td>" if sigs
+                else "<td class='dim'>0</td>")
+            mins = g["minimized"]
+            min_td = (f"<td>{len(mins)}</td>" if mins
+                      else "<td class='dim'>0</td>")
+            out.append(
+                f'<tr><td><a href="/{quote(g["dir"])}/?files">'
+                f'{html.escape(g["dir"])}</a></td>'
+                f"<td>{html.escape(when)}</td>"
+                f"<td>{g['budget']}</td><td>{g['runs']}</td>"
+                f"<td>{g['generations']}</td>{ff_td}{sig_td}"
+                f"<td>{g['corpus']}</td>{min_td}"
+                f"<td>{g['wall_s']}s</td></tr>")
+        out.append("</table>")
+
+    # -- minimized repros ----------------------------------------------------
+    shrunk = _shrink_rows(store_base)
+    if shrunk:
+        out.append(
+            "<h2>Minimized repros</h2>"
+            "<p class='dim'>delta-debugged failing schedules "
+            "(runner/shrink) — smallest nemesis schedule that still "
+            "reproduces the verdict signature, with its replay "
+            "command</p>"
+            "<table><tr><th>run</th><th>signature</th>"
+            "<th>windows</th><th>nemesis ops</th><th>executions</th>"
+            "<th>repro</th></tr>")
+        for s in shrunk:
+            out.append(
+                f'<tr><td><a href="/{quote(s["dir"])}/">'
+                f'{html.escape(s["dir"])}</a></td>'
+                f"<td><code>{html.escape(str(s['signature']))}</code>"
+                f"</td><td>{s['original_windows']}&rarr;"
+                f"{s['windows']}</td>"
+                f"<td>{s['nemesis_ops']}</td>"
+                f"<td>{s['executions']}</td>"
+                f"<td><code>{html.escape(str(s['repro']))}</code>"
+                f"</td></tr>")
+        out.append("</table>")
+
     # -- failure dedupe by verdict signature ---------------------------------
+    # Runs with a checker signature are real verdicts; runs that
+    # failed with no signature at all (crashed harness, truncated
+    # results.json, setup errors) are infrastructure noise and get
+    # their own section so verdict groups — and anything consuming
+    # them, like guided's novelty scoring — never mix the two.
     failing = [r for r in rows if r["valid?"] is not True]
+    verdicts = [r for r in failing if r["signature"]]
+    infra = [r for r in failing if not r["signature"]]
     out.append("<h2>Failure dedupe</h2>")
-    if not failing:
-        out.append("<p class='ok'>no failing runs</p>")
+    if not verdicts:
+        out.append("<p class='ok'>no failing checker verdicts</p>")
     else:
         groups: dict = {}
-        for r in failing:
-            groups.setdefault(r["signature"] or "(no checker verdict)",
-                              []).append(r)
+        for r in verdicts:
+            groups.setdefault(r["signature"], []).append(r)
         out.append("<table><tr><th>verdict signature</th>"
                    "<th>runs</th><th>dirs</th></tr>")
         for sig, rs in sorted(groups.items(),
@@ -521,6 +676,19 @@ def aggregate_html(store_base: str) -> str:
                 f'{html.escape(r["dir"])}</a>' for r in rs[:12])
             out.append(f"<tr><td><code>{html.escape(sig)}</code></td>"
                        f"<td>{len(rs)}</td><td>{links}</td></tr>")
+        out.append("</table>")
+    if infra:
+        out.append(
+            "<h2>Infrastructure / harness errors</h2>"
+            "<p class='dim'>failing runs with no checker verdict — "
+            "harness noise, not consistency results; excluded from "
+            "the verdict dedupe above</p>"
+            "<table><tr><th>run</th><th>valid?</th></tr>")
+        for r in infra[:24]:
+            out.append(
+                f'<tr><td><a href="/{quote(r["dir"])}/">'
+                f'{html.escape(r["dir"])}</a></td>'
+                f"<td>{_badge(r['valid?'])}</td></tr>")
         out.append("</table>")
     return "".join(out)
 

@@ -29,8 +29,10 @@ from .engine import (  # noqa: F401
     STRIDE,
     SUPPORTED_WORKLOADS,
     BatchConfig,
+    default_schedule,
     generate,
     generate_for_opts,
+    schedule_span,
     supports,
 )
 from .heap import DONE, BatchHeap  # noqa: F401

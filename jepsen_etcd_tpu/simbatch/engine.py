@@ -98,7 +98,11 @@ P_TIMEOUT = {"partition": 0.25, "latency": 0.06, "kill": 0.18}
 P_TIMEOUT_DEFAULT = 0.12
 
 #: stale-read injection rate (inject_stale_reads knob; the draw is
-#: always made so the knob cannot shift any other draw)
+#: always made so the knob cannot shift any other draw). With a fault
+#: schedule present the injection models a stale read served by a
+#: partitioned replica: it fires only while a partition window is OPEN
+#: (guided campaigns steer toward exactly those cells). With no faults
+#: at all it stays unconditional — the PR 13 regression template.
 STALE_P = 0.25
 
 #: ns between a nemesis invoke and its :info (fault apply latency)
@@ -118,19 +122,47 @@ def supports(workload: str) -> bool:
     return workload in SUPPORTED_WORKLOADS
 
 
+def _norm_schedule(schedule, nemeses):
+    """Normalize an explicit nemesis schedule to a sorted tuple of
+    ``(start_ns, kind, hold_ns)`` windows. ``kind`` must name a fault
+    in ``nemeses`` (the window replays that fault's start/stop pair)."""
+    if schedule is None:
+        return None
+    out = []
+    for w in schedule:
+        start, kind, hold = w
+        if kind not in nemeses:
+            raise ValueError(f"schedule window kind {kind!r} not in "
+                             f"nemeses {tuple(nemeses)!r}")
+        out.append((int(start), str(kind), int(hold)))
+    out.sort(key=lambda w: (w[0], w[2], w[1]))
+    return tuple(out)
+
+
 class BatchConfig:
     """Sizing + workload knobs; with a seed, fully determines one
     history. ``from_opts`` is the stable opts→config mapping the
     campaign router and bench use (changing it would re-key every
-    pinned golden hash — bump the epoch instead)."""
+    pinned golden hash — bump the epoch instead).
+
+    ``nem_schedule`` replays an explicit window list instead of the
+    drawn nemesis cycles; draws are still made in full, so a config
+    with no schedule is bit-identical to the pre-schedule epoch.
+    ``partition_shape``/``latency_ms``/``drop_prob`` are the guided
+    mutation knobs: shape swaps the partition start value, latency
+    scales the latency-window timeout rate, drop_prob adds a flat
+    timeout rate inside every open window."""
 
     __slots__ = ("workload", "nemeses", "lanes", "readers", "keys",
                  "ops_per_lane", "rate", "key_offset",
-                 "inject_stale_reads")
+                 "inject_stale_reads", "nem_schedule",
+                 "partition_shape", "latency_ms", "drop_prob")
 
     def __init__(self, workload="register", nemeses=(), lanes=8,
                  ops_per_lane=64, rate=200.0, keys=None, readers=None,
-                 key_offset=0, inject_stale_reads=False):
+                 key_offset=0, inject_stale_reads=False,
+                 nem_schedule=None, partition_shape=None,
+                 latency_ms=None, drop_prob=0.0):
         if workload not in SUPPORTED_WORKLOADS:
             raise ValueError(f"simbatch does not support workload "
                              f"{workload!r} (supported: "
@@ -146,6 +178,12 @@ class BatchConfig:
         self.rate = float(rate) if rate else 200.0
         self.key_offset = int(key_offset)
         self.inject_stale_reads = bool(inject_stale_reads)
+        self.nem_schedule = _norm_schedule(nem_schedule, self.nemeses)
+        self.partition_shape = (str(partition_shape)
+                                if partition_shape else None)
+        self.latency_ms = (float(latency_ms)
+                           if latency_ms is not None else None)
+        self.drop_prob = min(1.0, max(0.0, float(drop_prob or 0.0)))
 
     @classmethod
     def from_opts(cls, opts: dict) -> "BatchConfig":
@@ -163,7 +201,38 @@ class BatchConfig:
             rate=rate,
             key_offset=int(opts.get("key_offset") or 0),
             inject_stale_reads=bool(opts.get("inject_stale_reads")),
+            nem_schedule=opts.get("nem_schedule"),
+            partition_shape=opts.get("nem_partition_shape"),
+            latency_ms=opts.get("nem_latency_ms"),
+            drop_prob=opts.get("nem_drop_prob") or 0.0,
         )
+
+    def to_dict(self) -> dict:
+        """JSON-safe round-trip: ``BatchConfig(**cfg.to_dict())`` —
+        shrink artifacts persist this so replay does not depend on the
+        opts→config mapping staying stable."""
+        return {
+            "workload": self.workload, "nemeses": list(self.nemeses),
+            "lanes": self.lanes, "readers": self.readers,
+            "keys": self.keys, "ops_per_lane": self.ops_per_lane,
+            "rate": self.rate, "key_offset": self.key_offset,
+            "inject_stale_reads": self.inject_stale_reads,
+            "nem_schedule": ([list(w) for w in self.nem_schedule]
+                             if self.nem_schedule is not None else None),
+            "partition_shape": self.partition_shape,
+            "latency_ms": self.latency_ms, "drop_prob": self.drop_prob,
+        }
+
+    def cache_key(self) -> tuple:
+        """Hashable identity of everything that shapes a generated
+        history (besides the seed) — the campaign router coalesces a
+        cell only when this whole tuple matches, so guided mutants with
+        distinct schedules/knobs never share a generate() call."""
+        return (self.workload, self.nemeses, self.lanes, self.readers,
+                self.keys, self.ops_per_lane, self.rate,
+                self.key_offset, self.inject_stale_reads,
+                self.nem_schedule, self.partition_shape,
+                self.latency_ms, self.drop_prob)
 
     def f_table(self) -> list:
         base = (["read", "write", "cas"] if self.workload == "register"
@@ -177,6 +246,70 @@ class BatchConfig:
         return 3 if self.workload == "register" else 2
 
 
+def schedule_span(config: BatchConfig) -> int:
+    """Rough per-lane wall span of a run in ns — the same arithmetic
+    ``_draws`` uses to space nemesis cycles. Guided mutations draw new
+    window start/hold times inside this span."""
+    gap_ns = max(1_000_000, int(config.lanes * 1e9 / config.rate))
+    return config.ops_per_lane * (gap_ns + 3_000_000)
+
+
+def default_schedule(config: BatchConfig, seed: int) -> list:
+    """Materialize the DRAWN nemesis schedule of ``(config, seed)`` as
+    an explicit ``[(start_ns, kind, hold_ns), ...]`` window list.
+
+    Replaying it through ``nem_schedule`` reproduces the drawn run
+    bit-for-bit (pinned by tests): the phase machine's absolute event
+    times are start = prev stop-ok + wait, stop-ok = start +
+    2*NEM_APPLY_NS + hold, so the wait/hold draws convert to absolute
+    windows and back exactly. This is the shrinker's starting corpus
+    for runs that never carried an explicit schedule."""
+    if not config.nemeses:
+        return []
+    d = _draws(config, [int(seed)])
+    out, tcur = [], 0
+    for c in range(NEM_CYCLES):
+        start = tcur + int(d["nwait"][0, c])
+        hold = int(d["nhold"][0, c])
+        out.append((start, config.nemeses[int(d["nkind"][0, c])], hold))
+        tcur = start + 2 * NEM_APPLY_NS + hold
+    return out
+
+
+def _schedule_arrays(schedules, nemeses):
+    """Convert per-seed explicit window lists into the phase machine's
+    ``(nwait, nhold, nkind, n_cycles)`` arrays (ns, pre-STRIDE).
+
+    Inverse of :func:`default_schedule`'s absolute-time conversion;
+    short schedules are padded (padding is never reached because the
+    machine stops pushing at each seed's own cycle count)."""
+    S = len(schedules)
+    C = max([len(sc) for sc in schedules] + [1])
+    nwait = np.ones((S, C), np.int64)
+    nhold = np.ones((S, C), np.int64)
+    nkind = np.zeros((S, C), np.int64)
+    ncyc = np.array([len(sc) for sc in schedules], np.int64)
+    kidx = {kd: i for i, kd in enumerate(nemeses)}
+    for s, sc in enumerate(schedules):
+        prev_end = 0
+        for c, (start, kd, hold) in enumerate(sc):
+            nwait[s, c] = max(1, int(start) - prev_end)
+            nhold[s, c] = max(1, int(hold))
+            nkind[s, c] = kidx[kd]
+            prev_end = prev_end + nwait[s, c] + 2 * NEM_APPLY_NS \
+                + nhold[s, c]
+    return nwait, nhold, nkind, ncyc
+
+
+def _p_timeout(config: BatchConfig, kind: str) -> float:
+    """Per-kind in-window timeout probability with the guided knobs
+    folded in (defaults leave the pre-knob values bit-identical)."""
+    p = P_TIMEOUT.get(kind, P_TIMEOUT_DEFAULT)
+    if kind == "latency" and config.latency_ms is not None:
+        p = min(0.9, p * config.latency_ms / 40.0)
+    return min(1.0, p + config.drop_prob)
+
+
 def _draws(config: BatchConfig, seeds) -> dict:
     """Pre-draw every random block, one independent generator per seed.
 
@@ -184,13 +317,14 @@ def _draws(config: BatchConfig, seeds) -> dict:
     the config, never on simulation outcomes, so per-seed streams stay
     aligned and histories stay pure functions of (seed, config). The
     stale-read block is always drawn (even when injection is off) so
-    the knob cannot shift any other draw.
+    the knob cannot shift any other draw; likewise the nemesis blocks
+    are drawn even when an explicit ``nem_schedule`` replaces them.
     """
     L, O = config.lanes, config.ops_per_lane
     ncy = NEM_CYCLES
     gap_ns = max(1_000_000, int(config.lanes * 1e9 / config.rate))
     # rough per-lane span drives nemesis cycle spacing
-    span = O * (gap_ns + 3_000_000)
+    span = schedule_span(config)
     w_lo, w_hi = max(1, span // (3 * ncy)), max(2, span // (2 * ncy))
     cols = {k: [] for k in ("start", "fsel", "wval", "cold", "cnew",
                             "lat", "gap", "tmo", "stale",
@@ -223,9 +357,14 @@ _INV_PLANES = np.array([_CF, _CPKI, _CVAI, _CVBI, _CLAT])[:, None]
 _IF, _IPKI, _IVAI, _IVBI, _ILAT = range(5)
 
 
-def generate(config: BatchConfig, seeds) -> dict:
+def generate(config: BatchConfig, seeds, nem_schedules=None) -> dict:
     """Run S seeds' simulations in lockstep; return their histories
     born columnar.
+
+    ``nem_schedules`` (optional, one explicit window list per seed)
+    overrides the drawn nemesis cycles per lane — the shrinker re-runs
+    a whole candidate population in ONE call by repeating the failing
+    seed across lanes with a different candidate schedule each.
 
     Returns ``{"histories": [History per seed], "epoch": "epoch-v2",
     "seeds": [...], "events": int, "steps": int, "compactions": int}``.
@@ -240,6 +379,10 @@ def generate(config: BatchConfig, seeds) -> dict:
     is_register = config.workload == "register"
     has_nem = bool(config.nemeses)
     inject_stale = config.inject_stale_reads
+    # stale reads are replica-staleness: with faults configured they
+    # fire only inside an open partition window (see STALE_P)
+    part_idx = (config.nemeses.index("partition")
+                if "partition" in config.nemeses else -2)
     d = _draws(config, seeds)
     AR = np.arange(S)
 
@@ -274,11 +417,30 @@ def generate(config: BatchConfig, seeds) -> dict:
               (d["stale"] < STALE_P).astype(np.int64)]
     CL = np.stack([np.broadcast_to(p, (S, L, O)) for p in planes])
     p_by_kind = (np.array(
-        [P_TIMEOUT.get(kd, P_TIMEOUT_DEFAULT) for kd in config.nemeses]
+        [_p_timeout(config, kd) for kd in config.nemeses]
         or [0.0]) * 1e9).astype(np.int64)
-    nwaitE = d["nwait"] * STRIDE
-    nholdE = d["nhold"] * STRIDE
-    nkind = d["nkind"]
+    if nem_schedules is not None:
+        if len(nem_schedules) != S:
+            raise ValueError("nem_schedules must align with seeds "
+                             f"({len(nem_schedules)} != {S})")
+        scheds = [_norm_schedule(sc, config.nemeses) or ()
+                  for sc in nem_schedules]
+    elif config.nem_schedule is not None:
+        scheds = [config.nem_schedule] * S
+    else:
+        scheds = None
+    if has_nem and scheds is not None:
+        nw, nh, nkind, n_cycles = _schedule_arrays(scheds,
+                                                   config.nemeses)
+        nwaitE = nw * STRIDE
+        nholdE = nh * STRIDE
+        ncyc_cap = nkind.shape[1]
+    else:
+        nwaitE = d["nwait"] * STRIDE
+        nholdE = d["nhold"] * STRIDE
+        nkind = d["nkind"]
+        n_cycles = np.full(S, NEM_CYCLES, np.int64)
+        ncyc_cap = NEM_CYCLES
     nem_apply = NEM_APPLY_NS * STRIDE
     nfb = config.nem_f_base()
 
@@ -299,6 +461,7 @@ def generate(config: BatchConfig, seeds) -> dict:
     ncyci = np.zeros(S, np.int64)          # completed fault cycles
     win_active = np.zeros(S, bool)
     win_p = np.zeros(S, np.int64)
+    win_kind = np.full(S, -1, np.int64)    # open window's fault index
     applied = [[] for _ in range(S)]       # set workload: sorted adds
     snaps = [[] for _ in range(S)]         # set workload: read snaps
 
@@ -329,7 +492,8 @@ def generate(config: BatchConfig, seeds) -> dict:
         e_act.append(ALL)
         heap.push(startE[:, j0] + latE[:, j0, 0], j0, KIND_COMPLETE)
     if has_nem:
-        heap.push(nwaitE[:, 0] + NL, NL, KIND_NEM)
+        # explicit empty schedules leave those seeds fault-free
+        heap.push(nwaitE[:, 0] + NL, NL, KIND_NEM, n_cycles > 0)
 
     while True:
         t, kind, lane, act = heap.pop_min()
@@ -383,6 +547,9 @@ def generate(config: BatchConfig, seeds) -> dict:
                 rv, rl = ver[sr, kr], val[sr, kr]
                 if inject_stale:
                     stale_m = g[_CSTALE][m_r] == 1
+                    if has_nem:
+                        stale_m &= (win_active[m_r]
+                                    & (win_kind[m_r] == part_idx))
                     rv = np.where(stale_m, pver[sr, kr], rv)
                     rl = np.where(stale_m, pval[sr, kr], rl)
                 row_tc[m_r] = TC_OK
@@ -455,7 +622,7 @@ def generate(config: BatchConfig, seeds) -> dict:
             done_lanes += m_cmp & (ncur >= O)
         if has_nem and m_nem.any():
             ph = nphase
-            ci = np.minimum(ncyci, NEM_CYCLES - 1)
+            ci = np.minimum(ncyci, ncyc_cap - 1)
             nk = nkind[AR, ci]
             m_n0 = m_nem & (ph == 0)
             m_die = m_n0 & (done_lanes >= L)  # clients done: no window
@@ -477,10 +644,12 @@ def generate(config: BatchConfig, seeds) -> dict:
             row_vb[m_emit] = is_stop[m_emit]
             win_active = (win_active | m_sok) & ~m_eok
             win_p[m_sok] = p_by_kind[nk[m_sok]]
+            win_kind[m_sok] = nk[m_sok]
+            win_kind[m_eok] = -1
             ncyci = ncyci + m_eok
             nphase = np.where(m_emit, (ph + 1) % 4, nphase)
-            n_push = m_emit & ~(m_eok & (ncyci >= NEM_CYCLES))
-            ci2 = np.minimum(ncyci, NEM_CYCLES - 1)
+            n_push = m_emit & ~(m_eok & (ncyci >= n_cycles))
+            ci2 = np.minimum(ncyci, ncyc_cap - 1)
             ntm = np.where(m_sinv | m_einv, t + nem_apply,
                            np.where(m_sok, t + nholdE[AR, ci],
                                     t + nwaitE[AR, ci2]))
@@ -523,6 +692,17 @@ def generate(config: BatchConfig, seeds) -> dict:
             "compactions": heap.compactions}
 
 
+def _nem_start_value(config, kind):
+    """Start-op :info value for a fault kind, with the guided mutation
+    knobs (partition shape, latency delta) folded in."""
+    if kind == "partition" and config.partition_shape:
+        return config.partition_shape
+    if kind == "latency" and config.latency_ms is not None:
+        return {"delta-ms": config.latency_ms,
+                "jitter-ms": round(config.latency_ms / 5.0, 3)}
+    return NEM_START_VALUE.get(kind, "all")
+
+
 def _finish(config, seeds, e_time, e_tc, e_fc, e_proc, e_key, e_pk,
             e_va, e_vb, e_vc, e_act, snaps):
     """Gather each seed's rows (sorted by its unique event times) into
@@ -532,7 +712,7 @@ def _finish(config, seeds, e_time, e_tc, e_fc, e_proc, e_key, e_pk,
     key_table = ([config.key_offset + i for i in range(config.keys)]
                  if config.workload == "register" else [])
     proc_table = ["nemesis"]
-    nem_start = [NEM_START_VALUE.get(kd, "all")
+    nem_start = [_nem_start_value(config, kd)
                  for kd in config.nemeses] or [None]
     if not e_tc:
         empty = np.zeros(0, np.int64)

@@ -314,8 +314,21 @@ def _coverage_dirs(path: str) -> list:
 def coverage(path: str) -> dict:
     """The guided-campaign feature vector: how hard the checker had
     to work (frontier/rungs/spills) and what verdicts the fleet
-    produced (failure-signature histogram)."""
+    produced (failure-signature histogram).
+
+    A multi-host campaign's rows are tolerated, not required, to have
+    artifacts on this machine: error rows (agent deaths past the
+    requeue cap, crashed epilogues) carry no ``dir``, and a re-queued
+    or inline-stranded run may lack ``telemetry.jsonl``/``results.json``
+    — those fold into ``aggregate.skipped`` instead of erroring, and
+    the rows' per-host column folds into ``aggregate.hosts``."""
     from .serve import _failure_signature
+    rows_meta = None
+    if os.path.isfile(os.path.join(path, "campaign.json")) or \
+            path.endswith("campaign.json"):
+        _, summary = _load_campaign(path)
+        rows_meta = [r for r in (summary.get("runs") or [])
+                     if isinstance(r, dict)]
     runs = []
     for rdir in _coverage_dirs(path):
         try:
@@ -334,16 +347,29 @@ def coverage(path: str) -> dict:
                      "spills": int(ctr.get("wgl.host-spill", 0)),
                      "signature": _failure_signature(results)})
     sigs = Counter(r["signature"] for r in runs if r["signature"])
-    return {"runs": runs,
-            "aggregate": {
-                "count": len(runs),
-                "peak_frontier": max((r["frontier"] for r in runs),
-                                     default=0),
-                "rungs": sum(r["rungs"] for r in runs),
-                "spills": sum(r["spills"] for r in runs),
-                "invalid": sum(1 for r in runs
-                               if r["valid"] is not True),
-                "signatures": dict(sorted(sigs.items()))}}
+    agg = {"count": len(runs),
+           "peak_frontier": max((r["frontier"] for r in runs),
+                                default=0),
+           "rungs": sum(r["rungs"] for r in runs),
+           "spills": sum(r["spills"] for r in runs),
+           "invalid": sum(1 for r in runs
+                          if r["valid"] is not True),
+           "signatures": dict(sorted(sigs.items()))}
+    if rows_meta is not None:
+        agg["rows"] = len(rows_meta)
+        agg["skipped"] = max(0, len(rows_meta) - len(runs))
+        hosts: dict = {}
+        for r in rows_meta:
+            st = hosts.setdefault(r.get("host") or "local",
+                                  {"runs": 0, "invalid": 0,
+                                   "errors": 0})
+            st["runs"] += 1
+            if r.get("status") != "done":
+                st["errors"] += 1
+            elif r.get("valid") is not True:
+                st["invalid"] += 1
+        agg["hosts"] = hosts
+    return {"runs": runs, "aggregate": agg}
 
 
 def cmd_coverage(paths: list, as_json: bool) -> int:
@@ -361,8 +387,79 @@ def cmd_coverage(paths: list, as_json: bool) -> int:
     print(f"aggregate: peak_frontier={agg['peak_frontier']} "
           f"rungs={agg['rungs']} spills={agg['spills']} "
           f"invalid={agg['invalid']}")
+    if "rows" in agg:
+        print(f"  campaign rows: {agg['rows']} "
+              f"({agg['skipped']} without local artifacts)")
+        for host, st in sorted(agg.get("hosts", {}).items()):
+            print(f"  host {host}: runs={st['runs']} "
+                  f"invalid={st['invalid']} errors={st['errors']}")
     for sig, n in agg["signatures"].items():
         print(f"  signature x{n}: {sig}")
+    return 0
+
+
+def _find_guided(path: str) -> str:
+    """Resolve a --corpus operand to a guided.json: the file itself, a
+    guided dir containing one, or a store base (newest guided run)."""
+    if os.path.isfile(path) and path.endswith("guided.json"):
+        return path
+    direct = os.path.join(path, "guided.json")
+    if os.path.isfile(direct):
+        return direct
+    cands = []
+    for root, dirs, files in os.walk(path, followlinks=False):
+        dirs[:] = [d for d in dirs
+                   if not os.path.islink(os.path.join(root, d))]
+        if "guided.json" in files:
+            p = os.path.join(root, "guided.json")
+            cands.append((os.path.getmtime(p), p))
+            dirs[:] = []
+    if not cands:
+        raise SystemExit(f"tel: no guided.json under {path!r}")
+    return max(cands)[1]
+
+
+def corpus(path: str) -> dict:
+    """A guided campaign's search summary (guided.json)."""
+    gpath = _find_guided(path)
+    try:
+        with open(gpath) as fh:
+            out = json.load(fh)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        raise SystemExit(f"tel: unreadable guided summary "
+                         f"{gpath!r}: {e}")
+    out["path"] = gpath
+    return out
+
+
+def cmd_corpus(paths: list, as_json: bool) -> int:
+    out = corpus(paths[0])
+    if as_json:
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
+    print(f"guided campaign {out.get('name')}: "
+          f"{out.get('runs')}/{out.get('budget')} runs over "
+          f"{out.get('generations')} generation(s), "
+          f"master seed {out.get('master_seed')}")
+    sigs = out.get("signatures") or {}
+    for sig, run_no in sorted(sigs.items(), key=lambda kv: kv[1]):
+        print(f"  signature @run {run_no}: {sig}")
+    ff = out.get("first_failure_run")
+    print(f"  first failure: "
+          f"{'run %d' % ff if ff else '(none)'}  "
+          f"envelope={out.get('envelope')}")
+    for c in out.get("corpus") or []:
+        print(f"  ancestor @run {c.get('run')}: "
+              f"{c.get('opts', {}).get('workload')}/"
+              f"{','.join(c.get('opts', {}).get('nemesis') or []) or '-'}"
+              f" seed={c.get('seed')} score={c.get('score')}"
+              + (f" [{c['signature']}]" if c.get("signature") else ""))
+    for m in out.get("minimized") or []:
+        print(f"  minimized @run {m.get('run')}: "
+              f"{m.get('original_windows')}→{m.get('windows')} "
+              f"window(s), {m.get('nemesis_ops')} nemesis op(s) "
+              f"[{m.get('signature')}]")
+        print(f"    repro: {m.get('repro')}")
     return 0
 
 
@@ -372,6 +469,8 @@ def run(args) -> int:
     try:
         if args.ledger:
             return cmd_ledger(args.paths, args.as_json)
+        if getattr(args, "corpus", False):
+            return cmd_corpus(args.paths, args.as_json)
         if args.coverage:
             return cmd_coverage(args.paths, args.as_json)
         if args.diff:

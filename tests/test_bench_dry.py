@@ -132,6 +132,20 @@ def test_dry_service_scaling_cell(dry_all):
     assert cell["chips_used"] >= 1
 
 
+def test_dry_guided_search_cell(dry_all):
+    """Tier-1 guard on the guided-search cell's structure: same-seed
+    schedulers emit identical candidate generations, and a drawn fault
+    plan replays bit-identically as an explicit schedule (singly and as
+    a batched population) — the runs-to-failure speedup itself is only
+    measured by the real bench run, never here."""
+    cell = dry_all["guided_search"]
+    assert cell["ok"] is True and cell["check"] == "_dry_guided_search"
+    assert cell["candidates"] == 18
+    assert cell["mutated"] >= 1
+    assert cell["windows"] >= 1
+    assert cell["replay_identical"] is True
+
+
 def test_dry_rejects_unknown_cell():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     out = subprocess.run(

@@ -1,0 +1,236 @@
+"""Coverage-guided fault campaigns + schedule shrinking (runner/
+guided.py, runner/shrink.py).
+
+The headline test is the acceptance bar: against the seeded stale-read
+bug (which only fires inside open partition windows), the guided
+scheduler must find a failing run in no more than HALF the runs a
+uniform matrix sweep needs under the same budget and master seed — and
+the failure must land as an auto-shrunk, replayable store artifact of
+fewer than 10 nemesis ops, surfaced on the aggregate dashboard and
+``tel --corpus``.
+
+Everything here is deterministic: sim histories are pure functions of
+(seed, config), and the guided search is a pure function of its master
+seed, so the exact runs-to-failure numbers are stable across hosts.
+"""
+
+import json
+import os
+
+from jepsen_etcd_tpu.runner.guided import GuidedScheduler, run_guided
+from jepsen_etcd_tpu.runner.shrink import (checker_opts_from,
+                                           replay_artifact, shrink_run)
+from jepsen_etcd_tpu.simbatch import (BatchConfig, default_schedule,
+                                      generate, history_sha)
+
+#: the quarry: epoch-v2 sim runs with the seeded stale-read bug. The
+#: bare [] cell is EXCLUDED from the cell list below — with no
+#: nemeses the injection is unconditional (the legacy semantics
+#: tests/test_simbatch.py pins), which would hand the uniform arm a
+#: failure at run 1 and prove nothing.
+BASE = {"workload": "register", "nodes": ["n1", "n2", "n3"],
+        "concurrency": 6, "rate": 100.0, "time_limit": 1.0,
+        "inject_stale_reads": True, "gen_epoch": "epoch-v2"}
+CELLS = [["kill"], ["pause"], ["latency"], ["member"], ["partition"]]
+
+
+def _check(opts: dict, seed: int, nem_schedules=None) -> dict:
+    """One cheap single-seed evaluation: batched generation + the
+    workload checker, no store, no test runner."""
+    from jepsen_etcd_tpu.workloads import workloads
+    cfg = BatchConfig.from_opts(opts)
+    copts = checker_opts_from(opts)
+    checker = workloads()[cfg.workload](dict(copts))["checker"]
+    g = generate(cfg, [seed], nem_schedules=nem_schedules)
+    return checker.check(dict(copts), g["histories"][0])
+
+
+def test_explicit_schedule_replays_drawn_plan_bit_identically():
+    """The shrink determinism contract: materializing a run's drawn
+    fault plan as an explicit window list — singly or as a batched
+    same-seed population — changes NOTHING about the history."""
+    opts = dict(BASE, nemesis=["partition"], seed=12)
+    cfg = BatchConfig.from_opts(opts)
+    for seed in (7, 12, 31):
+        drawn = generate(cfg, [seed])["histories"][0]
+        sched = default_schedule(cfg, seed)
+        assert len(sched) >= 1
+        explicit = generate(cfg, [seed],
+                            nem_schedules=[sched])["histories"][0]
+        pop = generate(cfg, [seed] * 4,
+                       nem_schedules=[sched] * 4)["histories"]
+        sha = history_sha(drawn)
+        assert history_sha(explicit) == sha
+        assert all(history_sha(h) == sha for h in pop)
+
+
+def test_scheduler_is_deterministic_in_master_seed():
+    """Two schedulers with the same master seed emit byte-identical
+    candidate streams, including window/knob mutations of a shared
+    corpus ancestor."""
+    ancestor = dict(BASE, nemesis=["partition"], seed=99)
+    streams = []
+    for _ in range(2):
+        s = GuidedScheduler(BASE, ["register"], CELLS, seed0=7,
+                            master_seed=7)
+        s.corpus.append({"opts": ancestor, "seed": 99, "run": 1,
+                         "score": 4, "signature": "workload=False",
+                         "vector": {"frontier": 1, "rungs": 0,
+                                    "spills": 0}})
+        streams.append([s.next_generation(4) for _ in range(4)])
+    assert json.dumps(streams[0], sort_keys=True) == \
+        json.dumps(streams[1], sort_keys=True)
+    # the stratified gen 0 covers every cell exactly once first
+    gen0 = streams[0][0] + streams[0][1]
+    cells0 = [tuple(o["nemesis"]) for o in gen0[:len(CELLS)]]
+    assert cells0 == [tuple(c) for c in CELLS]
+
+
+def test_scoring_ignores_harness_noise():
+    """Rows without a real checker verdict never enter the corpus or
+    steer the envelope — guided must not chase infrastructure errors."""
+    s = GuidedScheduler(BASE, ["register"], CELLS, seed0=0)
+    err_row = {"status": "error", "workload": "register",
+               "nemesis": ["kill"], "seed": 1}
+    assert s.observe(dict(BASE), err_row, None) == 0
+    assert s.observe(dict(BASE), err_row,
+                     {"frontier": 99, "signature": "x=False"}) == 0
+    assert not s.corpus and not s.seen_signatures
+    ok_row = {"status": "done", "valid": True, "workload": "register",
+              "nemesis": ["kill"], "seed": 2}
+    score = s.observe(dict(BASE), ok_row,
+                      {"frontier": 3, "rungs": 0, "spills": 0,
+                       "signature": ""})
+    assert score > 0 and len(s.corpus) == 1
+
+
+def test_guided_finds_seeded_bug_in_half_the_uniform_runs(tmp_path):
+    """The acceptance bar, end to end: uniform matrix vs guided search
+    on the same budget class and master seed, then the novel failure
+    auto-shrinks to a < 10-op schedule that replays to the same
+    verdict signature and surfaces on /aggregate."""
+    from jepsen_etcd_tpu.runner.campaign import campaign_specs
+    from jepsen_etcd_tpu.serve import aggregate_html
+    from jepsen_etcd_tpu.tel_cli import corpus
+
+    # uniform arm: the test-all matrix in its own order, evaluated
+    # cheaply (same histories the full runner would generate)
+    specs = campaign_specs(BASE, ["register"], CELLS,
+                           runs_per_cell=6, seed0=7)
+    assert len(specs) == 30
+    uniform_first = None
+    for i, s in enumerate(specs):
+        res = _check(s["opts"], s["opts"]["seed"])
+        if res.get("valid?") is not True:
+            uniform_first = i + 1
+            break
+    assert uniform_first == 25  # partition cell is last in the matrix
+
+    # guided arm: less than half the uniform budget, same seed base
+    summary = run_guided(BASE, ["register"], CELLS, budget=12,
+                         seed0=7, pool=0, service=False, live=False,
+                         store_base=str(tmp_path), name="hunt")
+    assert summary["runs"] == 12
+    ff = summary["first_failure_run"]
+    assert ff is not None and ff <= uniform_first // 2, \
+        (ff, uniform_first)
+    assert summary["signatures"], "failure produced no signature"
+    ctr = (summary["telemetry"].get("counters") or {})
+    assert ctr.get("guided.runs") == 12
+    assert ctr.get("guided.failures", 0) >= 1
+    assert not ctr.get("guided.errors")
+
+    # the novel failure shrank into a replayable store artifact
+    assert summary["minimized"], "no minimized repro was produced"
+    m = summary["minimized"][0]
+    assert m["nemesis_ops"] < 10
+    art_path = os.path.join(m["dir"], "shrink.json")
+    assert os.path.isfile(art_path)
+    assert art_path in m["repro"]
+    rep = replay_artifact(art_path)
+    assert rep["match"] is True, rep
+    assert rep["signature"] == m["signature"]
+
+    # surfacing: aggregate dashboard + tel --corpus
+    page = aggregate_html(str(tmp_path))
+    assert "Guided campaigns" in page and "hunt/" in page
+    assert "Minimized repros" in page
+    assert "jepsen_etcd_tpu replay" in page
+    out = corpus(str(tmp_path))
+    assert out["first_failure_run"] == ff
+    assert out["minimized"][0]["nemesis_ops"] == m["nemesis_ops"]
+
+
+def test_shrink_minimizes_schedule_and_replays(tmp_path):
+    """Direct shrinker run on a known-failing (config, seed): the
+    four-window drawn plan minimizes to fewer windows, under 10
+    nemesis ops, and the artifact re-executes to the same signature."""
+    opts = dict(BASE, nemesis=["partition"], seed=12)
+    res = _check(opts, 12)
+    assert res.get("valid?") is False  # the quarry really fails here
+    art = shrink_run(opts, 12, store_dir=str(tmp_path))
+    assert art is not None
+    assert art["original_windows"] == 4
+    assert art["windows"] < art["original_windows"]
+    assert art["nemesis_ops"] < 10
+    assert art["executions"] <= 40
+    rep = replay_artifact(os.path.join(str(tmp_path), "shrink.json"))
+    assert rep["match"] is True and rep["signature"] == art["signature"]
+    # nothing to shrink without faults; no artifact is written
+    assert shrink_run(dict(BASE, nemesis=[]), 12,
+                      store_dir=str(tmp_path / "none")) is None
+
+
+def test_aggregate_separates_infrastructure_errors(tmp_path):
+    """Failure dedupe splits real checker verdicts from no-verdict
+    harness noise instead of lumping both under one group."""
+    from jepsen_etcd_tpu.serve import aggregate_html
+
+    def fake_run(name, results):
+        rdir = tmp_path / name / "0001"
+        rdir.mkdir(parents=True)
+        (rdir / "history.jsonl").write_text("")
+        (rdir / "results.json").write_text(json.dumps(results))
+
+    fake_run("verdict", {"valid?": False,
+                         "workload": {"valid?": False}})
+    fake_run("infra", {"valid?": False})
+    page = aggregate_html(str(tmp_path))
+    assert "workload=False" in page
+    assert "Infrastructure / harness errors" in page
+    assert "(no checker verdict)" not in page
+    assert "infra/0001" in page.split(
+        "Infrastructure / harness errors")[1]
+
+
+def test_coverage_tolerates_stranded_campaign_rows(tmp_path):
+    """tel --coverage on a multi-host campaign dir: error rows with no
+    dir and re-queued/inline-stranded rows without local artifacts fold
+    into skipped + the per-host column instead of erroring."""
+    from jepsen_etcd_tpu.tel_cli import coverage
+
+    cdir = tmp_path / "camp" / "0001"
+    done_dir = cdir / "run0"
+    done_dir.mkdir(parents=True)
+    (done_dir / "results.json").write_text(json.dumps(
+        {"valid?": True,
+         "telemetry": {"counters": {"wgl.max-frontier": 3}}}))
+    rows = [
+        {"index": 0, "status": "done", "valid": True,
+         "dir": str(done_dir), "host": "hostA"},
+        # agent death past the requeue cap: no dir at all
+        {"index": 1, "status": "error", "host": "hostB"},
+        # re-queued/inline-stranded: dir recorded, artifacts elsewhere
+        {"index": 2, "status": "done", "valid": False,
+         "dir": str(cdir / "gone"), "host": "hostB"},
+    ]
+    (cdir / "campaign.json").write_text(json.dumps(
+        {"name": "camp", "runs": rows}))
+    out = coverage(str(cdir))
+    agg = out["aggregate"]
+    assert agg["count"] == 1 and agg["peak_frontier"] == 3
+    assert agg["rows"] == 3 and agg["skipped"] == 2
+    assert agg["hosts"]["hostA"] == {"runs": 1, "invalid": 0,
+                                     "errors": 0}
+    assert agg["hosts"]["hostB"] == {"runs": 2, "invalid": 1,
+                                     "errors": 1}
