@@ -1245,6 +1245,52 @@ def bench_guided_search():
                           for m in mins]}
 
 
+#: the four MVCC consistency-surface workloads (ISSUE 18): batched
+#: generation + model build + surface checking, end to end
+_MVCC_WORKLOADS = ("register-stale", "ranges", "lock-lease",
+                   "compact-watch")
+
+
+def _mvcc_check_all(opts_base: dict, seeds: list) -> dict:
+    """Generate + check every surface workload; returns per-workload
+    event counts, asserting every verdict is clean."""
+    from jepsen_etcd_tpu.runner.shrink import checker_opts_from
+    from jepsen_etcd_tpu.simbatch import BatchConfig, generate
+    from jepsen_etcd_tpu.workloads import workloads as _workloads
+    per = {}
+    for wl in _MVCC_WORKLOADS:
+        opts = dict(opts_base, workload=wl)
+        cfg = BatchConfig.from_opts(opts)
+        copts = checker_opts_from(opts)
+        checker = _workloads()[wl](dict(copts))["checker"]
+        g = generate(cfg, list(seeds))
+        for h in g["histories"]:
+            res = checker.check(dict(copts), h)
+            assert res.get("valid?") is True, (wl, res)
+        per[wl] = g["events"]
+    return per
+
+
+def bench_mvcc_surfaces():
+    """Consistency-surface cell: end-to-end throughput of the MVCC
+    subsystem — batched generation of all four surface workloads, the
+    columnar model build (core/mvcc.py), and the surface checkers
+    (checkers/mvcc.py), 16 seeds each, every verdict clean."""
+    base = {"nodes": ["n1", "n2", "n3"], "concurrency": 8,
+            "rate": 200.0, "time_limit": 5.0, "gen_epoch": "epoch-v2"}
+    t0 = time.time()
+    per = _mvcc_check_all(base, list(range(16)))
+    wall = time.time() - t0
+    events = sum(per.values())
+    rate = events / max(wall, 1e-9)
+    note(f"mvcc-surfaces: {events} events over "
+         f"{len(per)} workloads x 16 seeds in {wall:.2f}s "
+         f"({rate:,.0f} ev/s, generate+model+check)")
+    return {"value": round(rate, 1), "unit": "events_per_s",
+            "events": events, "wall_s": round(wall, 2),
+            "per_workload": per}
+
+
 CELLS = [("register_100", bench_register_100),
          ("engine_crossover", bench_engine_crossover),
          ("deep_wgl_4n_2000", bench_deep_wgl),
@@ -1263,7 +1309,8 @@ CELLS = [("register_100", bench_register_100),
          ("telemetry_overhead", bench_telemetry_overhead),
          ("campaign_amortization", bench_campaign_amortization),
          ("service_scaling", bench_service_scaling),
-         ("guided_search", bench_guided_search)]
+         ("guided_search", bench_guided_search),
+         ("mvcc_surfaces", bench_mvcc_surfaces)]
 
 
 # ---------------------------------------------------------------------
@@ -1673,6 +1720,43 @@ def _dry_guided_search():
             "replay_identical": True}
 
 
+def _dry_mvcc_surfaces():
+    """MVCC surface structure at tiny size, no timing: every surface
+    workload generates and checks clean, and each engine injection
+    flag trips EXACTLY its pinned verdict class (the same pins
+    tests/test_mvcc.py regression-tests in depth)."""
+    from jepsen_etcd_tpu.runner.shrink import checker_opts_from
+    from jepsen_etcd_tpu.simbatch import BatchConfig, generate
+    from jepsen_etcd_tpu.workloads import workloads as _workloads
+
+    base = {"nodes": ["n1", "n2", "n3"], "concurrency": 8,
+            "rate": 200.0, "time_limit": 2.0, "gen_epoch": "epoch-v2",
+            "staleness_bound_s": 0.5}
+    per = _mvcc_check_all(base, [_DRY_SEED])
+    assert all(v > 0 for v in per.values()), per
+    pins = {"register-stale": ("inject_stale_snapshot", "staleness",
+                               "stale-beyond-bound"),
+            "ranges": ("inject_torn_range", "ranges", "torn-range"),
+            "lock-lease": ("inject_double_grant", "lease",
+                           "double-grant"),
+            "compact-watch": ("inject_compaction_swallow", "watch-mvcc",
+                              "lost-event")}
+    tripped = {}
+    for wl, (flag, key, klass) in pins.items():
+        opts = dict(base, workload=wl, **{flag: True})
+        cfg = BatchConfig.from_opts(opts)
+        copts = checker_opts_from(opts)
+        checker = _workloads()[wl](dict(copts))["checker"]
+        h = generate(cfg, [_DRY_SEED])["histories"][0]
+        res = checker.check(dict(copts), h)
+        assert res.get("valid?") is False, (wl, res)
+        classes = {v["class"] for v in res[key]["violations"]}
+        assert classes == {klass}, (wl, classes)
+        tripped[wl] = klass
+    return {"events": sum(per.values()), "workloads": len(per),
+            "pins": tripped}
+
+
 DRY_CHECKS = {"register_100": _dry_register,
               "engine_crossover": _dry_register,
               "deep_wgl_4n_2000": _dry_register,
@@ -1692,6 +1776,7 @@ DRY_CHECKS = {"register_100": _dry_register,
               "campaign_amortization": _dry_campaign,
               "service_scaling": _dry_service_scaling,
               "guided_search": _dry_guided_search,
+              "mvcc_surfaces": _dry_mvcc_surfaces,
               "register_10k": _dry_register}
 
 
@@ -1704,7 +1789,12 @@ LINT_GATED = ("jepsen_etcd_tpu/ops/wgl.py",
               # the campaign cell times these two: a thread-safety or
               # determinism slip there corrupts the dispatch ledger
               "jepsen_etcd_tpu/runner/campaign.py",
-              "jepsen_etcd_tpu/runner/checker_service.py")
+              "jepsen_etcd_tpu/runner/checker_service.py",
+              # the mvcc_surfaces cell times the columnar model build
+              # and the surface checkers: a dict materialization there
+              # IS the regression the cell exists to catch
+              "jepsen_etcd_tpu/core/mvcc.py",
+              "jepsen_etcd_tpu/checkers/mvcc.py")
 
 
 def _lint_gate() -> None:

@@ -2,6 +2,8 @@ from .core import (Checker, Compose, compose, Stats, UnhandledExceptions,
                    LogFilePattern, ClockPlot, Noop)
 from .independent import Independent, independent_checker
 from .linearizable import LinearizableChecker, linearizable, check_history
+from .mvcc import (BoundedStaleness, CompactionWatch, LeaseChurn,
+                   SnapshotRanges)
 from .perf import Perf
 from .session import SessionGuarantees, session_guarantees
 from .set_full import SetFull, set_full
@@ -11,6 +13,7 @@ __all__ = [
     "Checker", "Compose", "compose", "Stats", "UnhandledExceptions",
     "LogFilePattern", "ClockPlot", "Noop", "Independent",
     "independent_checker", "LinearizableChecker", "linearizable",
-    "check_history", "Perf", "SessionGuarantees", "session_guarantees",
-    "SetFull", "set_full", "TimelineHtml",
+    "check_history", "BoundedStaleness", "CompactionWatch",
+    "LeaseChurn", "SnapshotRanges", "Perf", "SessionGuarantees",
+    "session_guarantees", "SetFull", "set_full", "TimelineHtml",
 ]

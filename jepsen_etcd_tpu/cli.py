@@ -145,6 +145,15 @@ def build_parser() -> argparse.ArgumentParser:
                             "faults configured, only inside an open "
                             "partition window (the guided-campaign "
                             "target); with none, unconditionally")
+        s.add_argument("--staleness-bound-s", type=float, default=None,
+                       help="register-stale: max excusable read lag in "
+                            "virtual seconds (default 8.0)")
+        s.add_argument("--lease-ttl-ms", type=float, default=None,
+                       help="lock-lease: lease TTL clipping certain-"
+                            "hold windows (default 1500)")
+        s.add_argument("--compact-keep", type=int, default=None,
+                       help="compact-watch: revisions kept behind the "
+                            "compaction watermark (default 8)")
         s.add_argument("--only-workloads-expected-to-pass",
                        action="store_true")
         s.add_argument("--store", default="store")
@@ -217,6 +226,17 @@ def build_parser() -> argparse.ArgumentParser:
                       help="--guided: the search RNG seed (mutation/"
                            "crossover draws; default: --seed) — one "
                            "master seed fully determines the search")
+    camp.add_argument("--corpus-in", default=None, metavar="PATH",
+                      help="--guided: warm-start from a corpus "
+                           "exported by --corpus-out — ancestors "
+                           "join the pool and already-seen "
+                           "signatures/cells/envelope peaks stop "
+                           "scoring as novel")
+    camp.add_argument("--corpus-out", default=None, metavar="PATH",
+                      help="--guided: export the final novelty-scored "
+                           "corpus (ancestors, envelope, signature/"
+                           "cell ledgers) as JSON for a later "
+                           "--corpus-in")
     cs = sub.add_parser("checker-service",
                         help="run a standalone batched TPU checker "
                              "service: one process owns the device; "
@@ -287,8 +307,8 @@ def build_parser() -> argparse.ArgumentParser:
                          "trace-join accounting (exit 1 on mismatch)")
     tl.add_argument("--coverage", action="store_true",
                     help="emit the per-run + aggregate coverage "
-                         "vector (frontier, rungs, spills, verdict "
-                         "signatures)")
+                         "vector (frontier, wave depth, rungs, "
+                         "spills, verdict signatures)")
     tl.add_argument("--corpus", action="store_true",
                     help="inspect a guided campaign (guided.json): "
                          "corpus ancestors, novel signatures, "
@@ -372,6 +392,14 @@ def opts_from_args(args) -> dict:
         "soak_window_s": getattr(args, "soak_window_s", None),
         "soak_net_faults": getattr(args, "soak_net_fault", None) or [],
         "store_base": args.store,
+        # MVCC surface thresholds: only carried when given, so
+        # compose.default_opts keeps supplying the reference values
+        **{k: v for k, v in (
+            ("staleness_bound_s", getattr(args, "staleness_bound_s",
+                                          None)),
+            ("lease_ttl_ms", getattr(args, "lease_ttl_ms", None)),
+            ("compact_keep", getattr(args, "compact_keep", None)),
+        ) if v is not None},
     }
 
 
@@ -495,7 +523,8 @@ def main(argv=None) -> int:
                 name=args.campaign_name
                 if args.campaign_name != "campaign" else "guided",
                 live=not args.no_live, hosts=args.hosts or None,
-                on_row=_print_guided_row)
+                on_row=_print_guided_row,
+                corpus_in=args.corpus_in, corpus_out=args.corpus_out)
             print(json.dumps({
                 "guided": out["name"], "dir": out["dir"],
                 "budget": out["budget"], "runs": out["runs"],
@@ -503,6 +532,8 @@ def main(argv=None) -> int:
                 "signatures": out["signatures"],
                 "first_failure_run": out["first_failure_run"],
                 "corpus": len(out["corpus"]),
+                "corpus_imported": out["corpus_imported"],
+                "corpus_out": out["corpus_out"],
                 "minimized": [{k: m.get(k) for k in
                                ("dir", "signature", "windows",
                                 "nemesis_ops", "repro")}

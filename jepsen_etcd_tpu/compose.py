@@ -125,6 +125,17 @@ def default_opts() -> dict:
         "nem_drop_prob": 0.0,           # extra flat drop probability
                                         # added inside every open fault
                                         # window
+        "staleness_bound_s": 8.0,       # register-stale: max excusable
+                                        # read lag (virtual seconds)
+                                        # without an open fault window
+        "lease_ttl_ms": 1500,           # lock-lease: never-renewed
+                                        # lease TTL (churn pressure)
+        "compact_keep": 8,              # compact-watch: revisions kept
+                                        # behind the head per compaction
+        "inject_stale_snapshot": False,  # MVCC injection hooks
+        "inject_torn_range": False,      # (simbatch/engine.py): each
+        "inject_double_grant": False,    # seeds the one bug its
+        "inject_compaction_swallow": False,  # checker class pins
     }
 
 

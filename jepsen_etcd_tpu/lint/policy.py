@@ -34,6 +34,10 @@ COLUMNAR = (
     "checkers/timeline.py",
     "checkers/tpu_linearizable.py",
     "checkers/session.py",
+    "checkers/mvcc.py",
+    "core/mvcc.py",     # the MVCC model builds from OpColumns in one
+                        # pass; its dict-stream fallback is the single
+                        # declared ignore in history_columns
     "simbatch/*",       # the batched generator BIRTHS histories as
                         # columns; materializing dicts inside it would
                         # defeat the subsystem (history_sha's to_jsonl

@@ -2212,6 +2212,8 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
     tel.counter("wgl.dispatches")
     if peak.size:
         tel.counter("wgl.max-frontier", int(peak.max()), mode="max")
+    if waves.size:
+        tel.counter("wgl.waves", int(waves.max()), mode="max")
     for j, i in enumerate(idxs):
         p = packs[i]
         if overflow[j]:
@@ -2248,6 +2250,8 @@ def check_packed(p: Packed, f_max: Optional[int] = None,
     tel.counter("wgl.dispatches")
     if out.get("rungs"):
         tel.counter("wgl.rungs", out["rungs"])
+    if out.get("waves"):
+        tel.counter("wgl.waves", out["waves"], mode="max")
     if out.get("peak-frontier"):
         tel.counter("wgl.max-frontier", out["peak-frontier"], mode="max")
     return out

@@ -58,8 +58,20 @@ LATENCIES_MS = (8.0, 16.0, 32.0, 64.0, 128.0)
 CORPUS_CAP = 32
 
 #: feature-vector dimensions folded into the novelty envelope (the
-#: tel_cli.coverage vector keys, reused verbatim)
-ENVELOPE_DIMS = ("frontier", "rungs", "spills")
+#: tel_cli.coverage vector keys, reused verbatim). "waves" is BFS
+#: wave depth (wgl.waves, mode=max): histories that force deeper
+#: ladders are novel even at the same frontier width
+ENVELOPE_DIMS = ("frontier", "waves", "rungs", "spills")
+
+#: workload-parameter pools the "param" mutation hops along — each hop
+#: moves one step within a pool, so mutants explore key churn
+#: (ops_per_key rotates keys in), request rate, and client concurrency
+#: without teleporting across the space
+PARAM_POOLS = {
+    "ops_per_key": (64, 128, 200, 400),
+    "rate": (50.0, 100.0, 200.0, 400.0, 800.0),
+    "concurrency": (4, 8, 10, 16, 32),
+}
 
 
 def _copy_opts(opts: dict) -> dict:
@@ -131,7 +143,7 @@ class GuidedScheduler:
         anc = self._pick()
         opts = _copy_opts(anc["opts"])
         nem = list(opts.get("nemesis") or ())
-        ops = ["reseed", "cell"]
+        ops = ["reseed", "cell", "param"]
         if nem and _batchable(opts):
             ops += ["window"] * 3
             if "partition" in nem:
@@ -158,7 +170,31 @@ class GuidedScheduler:
             else:
                 opts["nem_latency_ms"] = float(
                     LATENCIES_MS[int(rng.integers(len(LATENCIES_MS)))])
+        elif op == "param":
+            self._hop_param(opts)
         return opts
+
+    def _hop_param(self, opts: dict) -> None:
+        """One step along a workload-parameter pool (PARAM_POOLS):
+        snap the current value to its nearest pool entry, then hop one
+        slot up or down. Drawn schedules key off (config, seed), so a
+        rate/concurrency hop also reshapes the fault plan timing —
+        that interplay is exactly what the dimension is for."""
+        rng = self.rng
+        names = sorted(PARAM_POOLS)
+        name = names[int(rng.integers(len(names)))]
+        pool = PARAM_POOLS[name]
+        cur = opts.get(name)
+        if cur is None:
+            cur = self.base.get(name)
+        try:
+            i = min(range(len(pool)),
+                    key=lambda j: abs(float(pool[j]) - float(cur)))
+        except (TypeError, ValueError):
+            i = int(rng.integers(len(pool)))
+        step = 1 if rng.random() < 0.5 else -1
+        i = min(len(pool) - 1, max(0, i + step))
+        opts[name] = pool[i]
 
     def _materialize(self, opts: dict) -> list:
         """The explicit window list a mutant starts from: the opts' own
@@ -207,6 +243,70 @@ class GuidedScheduler:
             else:
                 opts.pop(k, None)
         return opts
+
+    # -- corpus transfer ----------------------------------------------
+
+    def export_corpus(self) -> dict:
+        """JSON-able snapshot of the search state worth carrying into
+        the NEXT campaign: the ancestor corpus, the novelty envelope,
+        and the seen signature/cell ledgers (so a warmed-up search
+        only scores genuinely new behavior), plus the seed cursor (so
+        freshly minted seeds never collide with imported ancestors)."""
+        return {
+            "schema": 1, "kind": "guided-corpus",
+            "master_seed": self.master_seed,
+            "next_seed": self.next_seed,
+            "envelope": dict(self.envelope),
+            "signatures": dict(self.seen_signatures),
+            "cells": sorted([w, list(n)] for w, n in self.seen_cells),
+            "corpus": [dict(c) for c in self.corpus],
+        }
+
+    def import_corpus(self, data: dict) -> int:
+        """Merge an :meth:`export_corpus` payload: ancestors join the
+        pool (the cap still applies), the envelope widens to the
+        imported peaks, and imported signatures/cells stop scoring as
+        novel. Returns the number of ancestors added. Unknown envelope
+        dims in the payload are dropped; missing ones default to 0, so
+        corpora survive dimension growth across versions."""
+        if not isinstance(data, dict) \
+                or data.get("kind") != "guided-corpus":
+            raise ValueError(
+                "not a guided-corpus export (produce one with "
+                "campaign --guided --corpus-out PATH)")
+        self.next_seed = max(self.next_seed,
+                             int(data.get("next_seed") or 0))
+        env = data.get("envelope") or {}
+        for dim in ENVELOPE_DIMS:
+            v = int(env.get(dim) or 0)
+            if v > self.envelope[dim]:
+                self.envelope[dim] = v
+        for sig, run in (data.get("signatures") or {}).items():
+            self.seen_signatures.setdefault(str(sig), int(run))
+        for cell in data.get("cells") or ():
+            if isinstance(cell, (list, tuple)) and len(cell) == 2:
+                self.seen_cells.add((cell[0], tuple(cell[1] or ())))
+        added = 0
+        for c in data.get("corpus") or ():
+            if not (isinstance(c, dict) and isinstance(c.get("opts"),
+                                                       dict)):
+                continue
+            self.corpus.append({
+                "opts": _copy_opts(c["opts"]),
+                "seed": c.get("seed"),
+                "run": 0,               # pre-history: ties sort first
+                "score": int(c.get("score") or 1),
+                "signature": c.get("signature") or "",
+                "vector": {dim: int((c.get("vector") or {})
+                                    .get(dim) or 0)
+                           for dim in ENVELOPE_DIMS},
+                "imported": True,
+            })
+            added += 1
+        if len(self.corpus) > self.corpus_cap:
+            self.corpus.sort(key=lambda c: (-c["score"], c["run"]))
+            del self.corpus[self.corpus_cap:]
+        return added
 
     # -- scoring ------------------------------------------------------
 
@@ -257,7 +357,9 @@ def run_guided(base_opts: dict, workloads: list, nemeses: list, *,
                store_base: str = "store", name: str = "guided",
                start_method: str = "spawn", live: bool = False,
                hosts=None, shrink: bool = True, max_shrinks: int = 4,
-               gen_size: Optional[int] = None, on_row=None) -> dict:
+               gen_size: Optional[int] = None, on_row=None,
+               corpus_in: Optional[str] = None,
+               corpus_out: Optional[str] = None) -> dict:
     """Drive a guided campaign of ``budget`` runs; returns (and writes
     as ``<guided dir>/guided.json``) the search summary.
 
@@ -275,6 +377,11 @@ def run_guided(base_opts: dict, workloads: list, nemeses: list, *,
     tel = Telemetry(os.path.join(gdir, "telemetry.jsonl"), trace=trace)
     sched = GuidedScheduler(base, workloads, nemeses, seed0=seed0,
                             master_seed=master_seed)
+    imported = 0
+    if corpus_in:
+        with open(corpus_in) as f:
+            imported = sched.import_corpus(json.load(f))
+        tel.counter("guided.corpus-imported", imported)
     ledger: list[dict] = []
     minimized: list[dict] = []
     first_failure: Optional[int] = None
@@ -370,6 +477,8 @@ def run_guided(base_opts: dict, workloads: list, nemeses: list, *,
             "envelope": dict(sched.envelope),
             "first_failure_run": first_failure,
             "corpus": sched.corpus,
+            "corpus_imported": imported,
+            "corpus_in": corpus_in, "corpus_out": corpus_out,
             "minimized": minimized,
             "ledger": ledger,
             "wall_s": round(time.monotonic() - t0, 3),
@@ -377,6 +486,10 @@ def run_guided(base_opts: dict, workloads: list, nemeses: list, *,
         }
         with open(os.path.join(gdir, "guided.json"), "w") as f:
             json.dump(_scrub(out), f, indent=2, default=repr)
+        if corpus_out:
+            with open(corpus_out, "w") as f:
+                json.dump(_scrub(sched.export_corpus()), f, indent=2,
+                          default=repr)
         tel.close()
         link_latest(gdir)
     return out

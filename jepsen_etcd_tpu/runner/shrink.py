@@ -59,8 +59,15 @@ def _signature(results: dict) -> str:
 def checker_opts_from(opts: dict) -> dict:
     """The slice of run opts the workload checker factory needs."""
     nodes = opts.get("nodes") or ["n1", "n2", "n3"]
-    return {"nodes": list(nodes),
-            "concurrency": int(opts.get("concurrency") or 2 * len(nodes))}
+    out = {"nodes": list(nodes),
+           "concurrency": int(opts.get("concurrency") or 2 * len(nodes))}
+    # MVCC surface thresholds (checkers/mvcc.py reads them from the
+    # test map at check time): shrink/replay verdicts must honor the
+    # same bounds the original run was judged under
+    for k in ("staleness_bound_s", "lease_ttl_ms", "compact_keep"):
+        if opts.get(k) is not None:
+            out[k] = opts[k]
+    return out
 
 
 def _eval_population(config, seed, scheds, checker, checker_opts):

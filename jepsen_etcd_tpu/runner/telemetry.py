@@ -118,6 +118,9 @@ REGISTRY = {
         "wgl.rungs",
         "wgl.max-frontier",
         "wgl.host-spill",
+        "wgl.waves",              # deepest wave loop of any dispatch
+                                  # (mode=max) — the coverage envelope's
+                                  # wave-depth dimension
         "mxu.dispatches",
         "campaign.runs",          # runner/campaign.py sweep accounting
         "campaign.completed",
@@ -236,6 +239,7 @@ REGISTRY = {
         "guided.corpus",          # peak corpus size (mode=max)
         "guided.mutations",       # mutants generated
         "guided.crossovers",      # crossover children generated
+        "guided.corpus-imported",  # ancestors merged from --corpus-in
         "shrink.runs",            # runner/shrink.py: shrinks attempted
         "shrink.candidates",      # candidate schedules re-executed
         "shrink.rounds",          # ddmin rounds run
@@ -243,6 +247,16 @@ REGISTRY = {
         "shrink.irreproducible",  # failures that did not reproduce
                                   # under re-execution (left unshrunk)
         "shrink.artifacts",       # shrink.json artifacts written
+        "mvcc.reads",             # checkers/mvcc.py consistency
+        "mvcc.keys",              # surfaces: observations consumed per
+        "mvcc.writes",            # check over the core/mvcc.py model
+        "mvcc.ranges",
+        "mvcc.grants",
+        "mvcc.watches",
+        "mvcc.watch-events",
+        "mvcc.compactions",
+        "mvcc.violations",        # violations across all four surface
+                                  # checkers (0 on a clean run)
     ),
     "events": (
         "telemetry.dropped",

@@ -82,6 +82,27 @@ def _failure_signature(results: dict) -> str:
     return ", ".join(sorted(sig))
 
 
+#: MVCC consistency-surface checker keys (checkers/mvcc.py) surfaced
+#: as their own /aggregate column: surface name -> short label
+_SURFACES = {"staleness": "stale", "ranges": "ranges",
+             "lease": "lease", "watch-mvcc": "watch"}
+
+
+def _consistency_surface(results: dict) -> dict:
+    """``{label: {"valid": verdict, "violations": n}}`` for every MVCC
+    surface checker that ran in this run's composed workload result."""
+    wlr = results.get("workload")
+    out = {}
+    if isinstance(wlr, dict):
+        for key, label in _SURFACES.items():
+            sub = wlr.get(key)
+            if isinstance(sub, dict) and "valid?" in sub:
+                out[label] = {
+                    "valid": sub.get("valid?"),
+                    "violations": sub.get("violation-count", 0)}
+    return out
+
+
 def _run_rows(store_base: str) -> list[dict]:
     from .forensics import all_runs
     rows = []
@@ -112,6 +133,7 @@ def _run_rows(store_base: str) -> list[dict]:
                      "overlap": _overlap_ratio(
                          tel.get("phases") or {},
                          tel.get("counters") or {}),
+                     "consistency": _consistency_surface(results),
                      "signature": _failure_signature(results)})
     rows.sort(key=lambda r: r["mtime"], reverse=True)
     return rows
@@ -460,6 +482,7 @@ def aggregate_html(store_base: str) -> str:
     # -- per-run phase breakdown bars ----------------------------------------
     out.append("<h2>Phase breakdown (wall time per run)</h2>"
                "<table><tr><th>run</th><th>valid?</th>"
+               "<th>consistency</th>"
                "<th>gen ops/s</th><th>e2e/gen</th><th>phases</th></tr>")
     for r in rows:
         rate = r.get("gen_rate")
@@ -473,10 +496,22 @@ def aggregate_html(store_base: str) -> str:
                  f"generate'>{ov:.2f}&times;</td>"
                  if isinstance(ov, (int, float))
                  else "<td class='dim'>—</td>")
+        surf = r.get("consistency") or {}
+        if surf:
+            # per-surface verdicts of the MVCC consistency checkers
+            # (checkers/mvcc.py) composed into this run's workload
+            surf_td = "<td>" + " ".join(
+                f"{html.escape(label)}&nbsp;{_badge(s['valid'])}"
+                + (f"<span class='bad'>({s['violations']})</span>"
+                   if s["violations"] else "")
+                for label, s in surf.items()) + "</td>"
+        else:
+            surf_td = "<td class='dim'>—</td>"
         out.append(
             f'<tr><td><a href="/{quote(r["dir"])}/">'
             f'{html.escape(r["dir"])}</a></td>'
             f"<td>{_badge(r['valid?'])}</td>"
+            f"{surf_td}"
             f"{rate_td}{ov_td}"
             f"<td>{_phase_bar(r['phases'])}</td></tr>")
     out.append("</table><p class='dim'>"

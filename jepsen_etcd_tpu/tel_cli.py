@@ -345,11 +345,16 @@ def coverage(path: str) -> dict:
                      "frontier": int(ctr.get("wgl.max-frontier", 0)),
                      "rungs": int(ctr.get("wgl.rungs", 0)),
                      "spills": int(ctr.get("wgl.host-spill", 0)),
+                     # deepest BFS wave ladder reached (wgl.waves is a
+                     # mode=max counter): a depth dimension the width
+                     # features above can't see
+                     "waves": int(ctr.get("wgl.waves", 0)),
                      "signature": _failure_signature(results)})
     sigs = Counter(r["signature"] for r in runs if r["signature"])
     agg = {"count": len(runs),
            "peak_frontier": max((r["frontier"] for r in runs),
                                 default=0),
+           "peak_waves": max((r["waves"] for r in runs), default=0),
            "rungs": sum(r["rungs"] for r in runs),
            "spills": sum(r["spills"] for r in runs),
            "invalid": sum(1 for r in runs
@@ -383,8 +388,10 @@ def cmd_coverage(paths: list, as_json: bool) -> int:
         sig = f"  [{r['signature']}]" if r["signature"] else ""
         print(f"  {os.path.basename(r['dir'])}: "
               f"valid={r['valid']} frontier={r['frontier']} "
+              f"waves={r['waves']} "
               f"rungs={r['rungs']} spills={r['spills']}{sig}")
     print(f"aggregate: peak_frontier={agg['peak_frontier']} "
+          f"peak_waves={agg['peak_waves']} "
           f"rungs={agg['rungs']} spills={agg['spills']} "
           f"invalid={agg['invalid']}")
     if "rows" in agg:

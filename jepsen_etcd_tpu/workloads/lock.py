@@ -246,6 +246,74 @@ def _set_like_workload(client) -> dict:
     }
 
 
+class LeaseChurnClient(WorkloadClient):
+    """Lease-churn locking: short TTLs and NO keepalive, so leases
+    expire constantly and the lock server re-grants after every
+    expiry. Checked by checkers/mvcc.py LeaseChurn: no two sessions'
+    *certain-hold* windows (clipped at acquire-invoke + TTL) may
+    overlap — expired-lease re-grants are excused by the clip, so
+    this workload is expected to PASS even under pause faults, unlike
+    ``lock``/``lock-set``."""
+
+    LOCK = "churn"
+
+    def open(self, test: dict, node: str) -> "LeaseChurnClient":
+        new = super().open(test, node)
+        new.lease_lock = None
+        return new
+
+    async def invoke(self, test: dict, op: Op) -> Op:
+        from ..checkers.mvcc import DEFAULT_LEASE_TTL_MS
+        ttl_ns = int(test.get("lease_ttl_ms")
+                     or DEFAULT_LEASE_TTL_MS) * MS
+
+        async def go():
+            if op.f == "acquire":
+                if self.lease_lock:
+                    return op.evolve(type="fail", error="already-held")
+                lease_id = await self.conn.lease_grant(ttl_ns)
+                try:
+                    lock_key = await self.conn.acquire_lock(
+                        self.LOCK, lease_id)
+                except BaseException:
+                    try:
+                        await self.conn.lease_revoke(lease_id)
+                    except (SimError, TimeoutError):
+                        pass
+                    raise
+                self.lease_lock = {"lease-id": lease_id,
+                                   "lock-key": lock_key}
+                return op.evolve(type="ok")
+            if op.f == "release":
+                if not self.lease_lock:
+                    return op.evolve(type="fail", error="not-held")
+                ll, self.lease_lock = self.lease_lock, None
+                await self.conn.release_lock(ll["lock-key"])
+                await self.conn.lease_revoke(ll["lease-id"])
+                return op.evolve(type="ok")
+            raise ValueError(f"unknown f {op.f}")
+
+        return await lock_with_errors(op, go)
+
+
+def lease_workload(opts: dict) -> dict:
+    """Acquire/release churn under short, never-renewed leases
+    (checkers/mvcc.py LeaseChurn: overlapping certain-hold windows)."""
+    from ..checkers.mvcc import LeaseChurn
+
+    def acquires(test, ctx):
+        return {"f": "acquire", "value": None}
+
+    def releases(test, ctx):
+        return {"f": "release", "value": None}
+
+    return {
+        "client": LeaseChurnClient(),
+        "checker": compose({"lease": LeaseChurn()}),
+        "generator": mix([acquires, releases]),
+    }
+
+
 def set_workload(opts: dict) -> dict:
     """In-memory set under an etcd lock (lock.clj:248-259)."""
     return _set_like_workload(LockingSetClient())

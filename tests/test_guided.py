@@ -104,6 +104,54 @@ def test_scoring_ignores_harness_noise():
     assert score > 0 and len(s.corpus) == 1
 
 
+def test_corpus_export_import_roundtrip():
+    """A corpus exported from one search warm-starts another: the
+    ancestor joins the pool, the envelope widens to the imported
+    peaks, and already-seen signatures/cells stop scoring as novel."""
+    s = GuidedScheduler(BASE, ["register"], CELLS, seed0=7,
+                        master_seed=7)
+    row = {"status": "done", "valid": False, "workload": "register",
+           "nemesis": ["kill"], "seed": 2}
+    vec = {"frontier": 3, "waves": 2, "rungs": 1, "spills": 0,
+           "signature": "workload=False"}
+    assert s.observe(dict(BASE, nemesis=["kill"]), row, vec) > 0
+    data = json.loads(json.dumps(s.export_corpus()))
+    assert data["kind"] == "guided-corpus"
+
+    s2 = GuidedScheduler(BASE, ["register"], CELLS, seed0=7,
+                         master_seed=11)
+    assert s2.import_corpus(data) == 1
+    assert s2.envelope["frontier"] == 3 and s2.envelope["waves"] == 2
+    assert len(s2.corpus) == 1 and s2.corpus[0]["imported"]
+    # seeds minted after import never collide with exported ones
+    assert s2.next_seed >= s.next_seed
+    # nothing in the imported payload is novel to the warmed search
+    row2 = dict(row, seed=3)
+    assert s2.observe(dict(BASE, nemesis=["kill"]), row2, dict(vec)) == 0
+    # garbage payloads are rejected, not absorbed
+    import pytest
+    with pytest.raises(ValueError):
+        s2.import_corpus({"kind": "something-else"})
+
+
+def test_param_mutation_hops_within_pools():
+    """The param dimension only hops along its declared pools — one
+    parameter per mutation, always to a pool value."""
+    from jepsen_etcd_tpu.runner.guided import PARAM_POOLS
+    s = GuidedScheduler(BASE, ["register"], CELLS, seed0=0,
+                        master_seed=3)
+    touched = set()
+    for _ in range(64):
+        o = dict(BASE, nemesis=["kill"], seed=1)
+        before = {k: o.get(k) for k in PARAM_POOLS}
+        s._hop_param(o)
+        changed = [k for k in PARAM_POOLS if o.get(k) != before[k]]
+        assert len(changed) == 1, changed
+        assert o[changed[0]] in PARAM_POOLS[changed[0]], changed
+        touched.update(changed)
+    assert len(touched) >= 2, touched
+
+
 def test_guided_finds_seeded_bug_in_half_the_uniform_runs(tmp_path):
     """The acceptance bar, end to end: uniform matrix vs guided search
     on the same budget class and master seed, then the novel failure

@@ -1,0 +1,165 @@
+"""MVCC consistency surfaces (ISSUE 18): bounded staleness, snapshot
+ranges, lease churn, compaction-vs-watch.
+
+Two regression walls around the new subsystem:
+
+- **Injection pins**: each checker verdict class is tested against the
+  one simbatch injection that seeds its bug (engine.py ``inject_*``
+  hooks). Flag on → every seed fails with EXACTLY that class; flag
+  off → every seed passes. A checker that goes soft (misses its bug)
+  or trigger-happy (new classes leak in) fails here, not in the field.
+- **Cross-epoch verdict equality**: the same cell judged on an
+  epoch-v1 (SimLoop event loop) history and an epoch-v2 (batched
+  lockstep) history must produce the same surface verdict — the
+  consistency claims are properties of the protocol semantics, not of
+  which generator produced the history. One lean cell per workload
+  runs in tier-1; the full workload × nemesis sweep is ``slow``.
+"""
+
+import pytest
+
+from jepsen_etcd_tpu.compose import etcd_test
+from jepsen_etcd_tpu.runner.shrink import checker_opts_from
+from jepsen_etcd_tpu.runner.test_runner import run_test
+from jepsen_etcd_tpu.simbatch import BatchConfig, generate
+from jepsen_etcd_tpu.workloads import workloads
+
+#: workload -> its surface checker's key in the composed result
+SURFACE_KEYS = {"register-stale": "staleness", "ranges": "ranges",
+                "lock-lease": "lease", "compact-watch": "watch-mvcc"}
+
+#: workload -> (engine injection flag, the ONE verdict class it pins)
+INJECTIONS = {
+    "register-stale": ("inject_stale_snapshot", "stale-beyond-bound"),
+    "ranges": ("inject_torn_range", "torn-range"),
+    "lock-lease": ("inject_double_grant", "double-grant"),
+    "compact-watch": ("inject_compaction_swallow", "lost-event"),
+}
+
+
+def _v2_opts(wl: str, **kw) -> dict:
+    o = {"workload": wl, "nodes": ["n1", "n2", "n3"], "concurrency": 8,
+         "rate": 200.0, "time_limit": 2.0, "gen_epoch": "epoch-v2"}
+    if wl == "register-stale":
+        # tight bound so a frozen-replica lag is beyond-bound within
+        # the short run (the default 8 s would excuse everything here)
+        o["staleness_bound_s"] = 0.5
+    o.update(kw)
+    return o
+
+
+def _v2_verdicts(opts: dict, seeds) -> list:
+    """Cheap epoch-v2 evaluations: batched generation + the composed
+    workload checker, no store, no test runner."""
+    cfg = BatchConfig.from_opts(opts)
+    copts = checker_opts_from(opts)
+    checker = workloads()[cfg.workload](dict(copts))["checker"]
+    g = generate(cfg, list(seeds))
+    return [checker.check(dict(copts), h) for h in g["histories"]]
+
+
+def _surface_verdict(sub: dict) -> tuple:
+    classes = sorted({v["class"] for v in sub.get("violations", ())})
+    return sub["valid?"], tuple(classes)
+
+
+@pytest.mark.parametrize("wl", sorted(SURFACE_KEYS))
+def test_injected_bug_trips_exactly_its_class(wl):
+    """Flag off: all 8 seeds pass. Flag on: all 8 seeds fail with the
+    pinned class and nothing else — the injection is definite for its
+    checker, and the checker convicts only its own bug."""
+    flag, klass = INJECTIONS[wl]
+    key = SURFACE_KEYS[wl]
+    seeds = range(8)
+    for r in _v2_verdicts(_v2_opts(wl), seeds):
+        assert r["valid?"] is True, (wl, r[key])
+    for r in _v2_verdicts(_v2_opts(wl, **{flag: True}), seeds):
+        assert r["valid?"] is False
+        sub = r[key]
+        assert sub["valid?"] is False
+        classes = {v["class"] for v in sub["violations"]}
+        assert classes == {klass}, (wl, classes)
+
+
+def test_injections_are_isolated_per_surface():
+    """A foreign injection must not convict a bystander surface: the
+    torn-range bug runs under the register-stale workload's checker
+    (and vice versa) without tripping it."""
+    for r in _v2_verdicts(_v2_opts("register-stale",
+                                   inject_torn_range=True), range(4)):
+        assert r["valid?"] is True, r["staleness"]
+    for r in _v2_verdicts(_v2_opts("ranges",
+                                   inject_stale_snapshot=True),
+                          range(4)):
+        assert r["valid?"] is True, r["ranges"]
+
+
+# -- cross-epoch verdict equality -----------------------------------------
+
+#: epoch-v1 faults start after compose's 5 virtual-second grace sleep,
+#: so time_limit must leave room for real fault windows
+_V1_BASE = {"nodes": ["n1", "n2", "n3"], "concurrency": 6,
+            "time_limit": 12, "rate": 100.0, "nemesis_interval": 3,
+            "seed": 5}
+
+#: lean tier-1 slice: every workload once, faults on two of them
+CELLS_TIER1 = [("register-stale", ()), ("ranges", ("kill",)),
+               ("lock-lease", ("partition",)), ("compact-watch", ())]
+
+#: the rest of workloads x {none, kill, partition}
+CELLS_FULL = [(wl, nem)
+              for wl in sorted(SURFACE_KEYS)
+              for nem in ((), ("kill",), ("partition",))
+              if (wl, nem) not in CELLS_TIER1]
+
+
+def _cross_epoch_cell(tmp_path, wl, nem):
+    key = SURFACE_KEYS[wl]
+    base = dict(_V1_BASE, workload=wl, nemesis=list(nem),
+                store_base=str(tmp_path))
+    v1 = run_test(etcd_test(dict(base)))["results"]["workload"][key]
+    v2 = _v2_verdicts(dict(base, gen_epoch="epoch-v2"),
+                      [base["seed"]])[0][key]
+    assert _surface_verdict(v1) == _surface_verdict(v2), (wl, nem, v1, v2)
+    # the new workloads are expected-to-pass across the fault matrix
+    assert v1["valid?"] is True, (wl, nem, v1)
+
+
+@pytest.mark.parametrize("wl,nem", CELLS_TIER1)
+def test_cross_epoch_verdict_equality(tmp_path, wl, nem):
+    _cross_epoch_cell(tmp_path, wl, nem)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("wl,nem", CELLS_FULL)
+def test_cross_epoch_verdict_equality_full(tmp_path, wl, nem):
+    _cross_epoch_cell(tmp_path, wl, nem)
+
+
+def test_aggregate_grows_consistency_surface_column(tmp_path):
+    """/aggregate surfaces the MVCC checker verdicts as their own
+    column: per-surface badges with violation counts for runs that
+    composed a surface checker, an em-dash for runs that didn't."""
+    import json
+    import os
+
+    from jepsen_etcd_tpu.serve import aggregate_html
+
+    def fake_run(name, results):
+        rdir = os.path.join(str(tmp_path), name, "0001")
+        os.makedirs(rdir)
+        open(os.path.join(rdir, "history.jsonl"), "w").close()
+        with open(os.path.join(rdir, "results.json"), "w") as f:
+            json.dump(results, f)
+
+    fake_run("surfaced", {
+        "valid?": False,
+        "workload": {"valid?": False,
+                     "staleness": {"valid?": False,
+                                   "violation-count": 3},
+                     "lease": {"valid?": True}}})
+    fake_run("plain", {"valid?": True, "workload": {"valid?": True}})
+    page = aggregate_html(str(tmp_path))
+    assert "consistency" in page
+    assert "stale&nbsp;" in page and "(3)" in page
+    assert "lease&nbsp;" in page
