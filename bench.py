@@ -770,6 +770,39 @@ def bench_gen_throughput():
     note(f"gen-throughput batched: {bgen['events']} events across "
          f"{len(seeds)} seeds in {b_s:.2f}s ({b_rate:,.0f} aggregate "
          f"events/s, {bgen['epoch']})")
+    # jitted leg (ISSUE 19): the SAME batch shape through the epoch-v3
+    # device engine (simbatch/engine_jax.py). The warm-up MUST run at
+    # the real (config, S): batch size and per-lane op count are shape
+    # dims of both jits, so warming at a toy shape recompiles inside
+    # the timed region and the leg reads ~0.5x instead of ~4x. Bar:
+    # >= 2x the numpy-batched leg (PERF.md §gen-jitted).
+    jopts = dict(bopts, gen_epoch="epoch-v3")
+    generate_for_opts(jopts, seeds)  # warm: compile at the real shape
+    jt0 = time.time()
+    jgen = generate_for_opts(jopts, seeds)
+    j_s = time.time() - jt0
+    j_rate = jgen["events"] / max(j_s, 1e-9)
+    note(f"gen-throughput jitted: {jgen['events']} events across "
+         f"{len(seeds)} seeds in {j_s:.2f}s ({j_rate:,.0f} aggregate "
+         f"events/s, {jgen['epoch']}, "
+         f"{j_rate / max(b_rate, 1e-9):.1f}x batched)")
+    # seed-axis scaling: the vmapped lanes amortize over S, so a short
+    # config at S=256 must not cost more per seed than at S=16 (both
+    # legs warmed at their own shape first — S is a shape dim).
+    sopts = dict(jopts, time_limit=1.0)
+    scaling = {}
+    for n in (16, 256):
+        ss = list(range(n))
+        generate_for_opts(sopts, ss)
+        st0 = time.time()
+        sgen = generate_for_opts(sopts, ss)
+        scaling[n] = {"wall_s": round(time.time() - st0, 3),
+                      "events": sgen["events"],
+                      "per_seed_ms": round(
+                          1e3 * (time.time() - st0) / n, 2)}
+    note(f"gen-throughput jitted scaling: per-seed "
+         f"{scaling[16]['per_seed_ms']}ms at S=16 vs "
+         f"{scaling[256]['per_seed_ms']}ms at S=256")
     return {"value": round(rate, 1), "unit": "events/s",
             "gen_s": round(gen_s, 2), "events": total,
             "per_op_us": round(1e6 * gen_s / max(total, 1), 2),
@@ -786,6 +819,14 @@ def bench_gen_throughput():
                             b_rate / len(seeds), 1),
                         "vs_single_stream": round(
                             b_rate / max(rate, 1e-9), 2)},
+            "jitted": {"value": round(j_rate, 1),
+                       "unit": "aggregate events/s",
+                       "seeds": len(seeds), "events": jgen["events"],
+                       "gen_s": round(j_s, 3),
+                       "epoch": jgen["epoch"],
+                       "vs_batched": round(
+                           j_rate / max(b_rate, 1e-9), 2),
+                       "scaling": scaling},
             "vs_baseline": round(rate / SEED_GEN_OPS_PER_S, 2)}
 
 
@@ -838,6 +879,58 @@ def bench_streaming_overlap():
             "posthoc_e2e_s": round(posthoc_e2e, 2),
             "verdicts_identical": True,
             "vs_baseline": round(posthoc_e2e / max(stream_e2e, 1e-9),
+                                 2)}
+
+
+def bench_fused_pipeline():
+    """Fused gen->check cell (ISSUE 19): epoch-v3 jitted generation
+    feeding ``check_prefix`` via PackStream chunk slices while later
+    sub-batches are still generating, vs the SAME seeds run strictly
+    sequentially (generate everything, then check everything). Both
+    hot legs release the GIL inside jitted dispatches, so unlike
+    §streaming's Python-bound producer the overlap is real. Bar:
+    fused e2e <= ~1.2x max(gen_s, check_s) — the cheaper phase rides
+    inside the dominant one (PERF.md §gen-jitted). Verdicts must be
+    IDENTICAL between fused and sequential runs (asserted, not
+    reported — a divergence is a soundness bug, not a slow cell)."""
+    from jepsen_etcd_tpu.runner.stream import FusedPipeline
+    from jepsen_etcd_tpu.simbatch import generate_for_opts
+    opts = {"workload": "register", "nodes": ["n1", "n2", "n3"],
+            "concurrency": 16, "rate": 1000.0, "time_limit": 7.52}
+    seeds = list(range(16))
+    # warm at the real shapes: generation jits compile at the
+    # sub-batch size (a shape dim), check kernels at the pack widths
+    warm = FusedPipeline(opts)
+    warm.run(seeds[:warm.sub_batch])
+    fused = FusedPipeline(opts).run(seeds)
+    # sequential twin: one full batch generate, then the identical
+    # per-history pack+prefix walk (same PackStream, same ladder)
+    t0 = time.time()
+    gen = generate_for_opts(dict(opts, gen_epoch="epoch-v3"), seeds)
+    seq_gen_s = time.time() - t0
+    twin = FusedPipeline(opts)
+    t0 = time.time()
+    seq_verdicts = {sd: twin._check_history(sd, h)[0]
+                    for sd, h in zip(seeds, gen["histories"])}
+    seq_check_s = time.time() - t0
+    seq_e2e = seq_gen_s + seq_check_s
+    assert seq_verdicts == fused["verdicts"], \
+        "fused verdicts diverged from sequential"
+    note(f"fused-pipeline: {len(seeds)} seeds, gen {fused['gen_s']:.2f}s"
+         f" || check {fused['check_s']:.2f}s -> e2e {fused['e2e_s']:.2f}s"
+         f" ({fused['ratio']:.3f}x max leg) vs sequential "
+         f"{seq_e2e:.2f}s; packs={fused['packs']} "
+         f"waves={fused['waves']}")
+    return {"value": round(fused["ratio"], 3),
+            "unit": "e2e/max(gen,check)",
+            "seeds": len(seeds),
+            "gen_s": round(fused["gen_s"], 3),
+            "check_s": round(fused["check_s"], 3),
+            "e2e_s": round(fused["e2e_s"], 3),
+            "seq_e2e_s": round(seq_e2e, 3),
+            "packs": fused["packs"], "waves": fused["waves"],
+            "verdicts_identical": True,
+            "vs_baseline": round(seq_e2e / max(fused["e2e_s"], 1e-9),
                                  2)}
 
 
@@ -1305,6 +1398,7 @@ CELLS = [("register_100", bench_register_100),
          ("closure_scale_2048", bench_closure_scale),
          ("watch_edit_distance", bench_watch),
          ("streaming_overlap", bench_streaming_overlap),
+         ("fused_pipeline", bench_fused_pipeline),
          ("net_overhead", bench_net_overhead),
          ("telemetry_overhead", bench_telemetry_overhead),
          ("campaign_amortization", bench_campaign_amortization),
@@ -1449,7 +1543,9 @@ def _dry_gen_throughput():
                        "record", "sut", "other"}, bk
     assert bk["generator_poll"]["s"] > 0 and bk["sut"]["s"] > 0, bk
     batched = _dry_gen_batched()
-    return {"ops": len(h), "events": len(cols), "batched": batched}
+    jitted = _dry_gen_jitted()
+    return {"ops": len(h), "events": len(cols), "batched": batched,
+            "jitted": jitted}
 
 
 def _dry_gen_batched():
@@ -1478,6 +1574,68 @@ def _dry_gen_batched():
     assert sh1 == sh2, "batched generation not deterministic"
     return {"seeds": len(seeds), "events": g1["events"],
             "steps": g1["steps"]}
+
+
+def _dry_gen_jitted():
+    """Structural twin of the jitted leg (no timing asserts): the
+    epoch-v3 route through generate_for_opts produces columnar,
+    deterministic histories stamped with the v3 ledger epoch, at the
+    same tiny shape as the batched dry check."""
+    from jepsen_etcd_tpu.simbatch import (GEN_EPOCH_V3,
+                                          generate_for_opts,
+                                          history_sha)
+    jopts = {"workload": "register", "nodes": ["n1", "n2", "n3"],
+             "concurrency": 8, "rate": 200.0, "time_limit": 2.0,
+             "seed": _DRY_SEED, "gen_epoch": "epoch-v3"}
+    seeds = list(range(16))
+    g1 = generate_for_opts(jopts, seeds)
+    assert g1["epoch"] == GEN_EPOCH_V3, g1["epoch"]
+    assert len(g1["histories"]) == 16
+    assert g1["events"] == sum(len(h) for h in g1["histories"])
+    for h in g1["histories"]:
+        assert h._ops is None, "jitted history materialized dicts"
+        assert len(h.columns) == len(h)
+    g2 = generate_for_opts(jopts, seeds)
+    sh1 = [history_sha(h) for h in g1["histories"]]
+    sh2 = [history_sha(h) for h in g2["histories"]]
+    assert sh1 == sh2, "jitted generation not deterministic"
+    assert len(set(sh1)) == 16, "jitted seeds not distinct"
+    return {"seeds": len(seeds), "events": g1["events"]}
+
+
+def _dry_fused_pipeline():
+    """Structural twin of the fused cell: a tiny seed set through
+    FusedPipeline with a small chunk size (forcing multi-chunk packing
+    per history) must produce the IDENTICAL verdict map as the
+    sequential generate-then-check twin, and the overlap accounting
+    fields must be present and self-consistent."""
+    from jepsen_etcd_tpu.runner.stream import FusedPipeline
+    from jepsen_etcd_tpu.simbatch import generate_for_opts
+    opts = {"workload": "register", "nodes": ["n1", "n2", "n3"],
+            "concurrency": 8, "rate": 200.0, "time_limit": 2.0}
+    seeds = list(range(4))
+    fused = FusedPipeline(opts, sub_batch=2,
+                          chunk_rows=64).run(seeds)
+    assert sorted(fused["verdicts"]) == seeds, fused["verdicts"]
+    assert fused["packs"] >= len(seeds), fused
+    assert fused["waves"] > 0, fused
+    assert fused["e2e_s"] >= max(fused["gen_s"], fused["check_s"]), \
+        fused
+    gen = generate_for_opts(dict(opts, gen_epoch="epoch-v3"), seeds)
+    twin = FusedPipeline(opts, chunk_rows=64)
+    seq_verdicts = {sd: twin._check_history(sd, h)[0]
+                    for sd, h in zip(seeds, gen["histories"])}
+    assert seq_verdicts == fused["verdicts"], \
+        (seq_verdicts, fused["verdicts"])
+    try:
+        FusedPipeline(dict(opts, workload="set"))
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("non-register workload accepted")
+    return {"seeds": len(seeds), "packs": fused["packs"],
+            "waves": fused["waves"],
+            "verdicts": fused["verdicts"]}
 
 
 def _dry_watch():
@@ -1771,6 +1929,7 @@ DRY_CHECKS = {"register_100": _dry_register,
               "closure_scale_2048": _dry_closure,
               "watch_edit_distance": _dry_watch,
               "streaming_overlap": _dry_streaming,
+              "fused_pipeline": _dry_fused_pipeline,
               "net_overhead": _dry_net_overhead,
               "telemetry_overhead": _dry_telemetry_overhead,
               "campaign_amortization": _dry_campaign,

@@ -186,13 +186,18 @@ def build_parser() -> argparse.ArgumentParser:
                       help="store dir name for the campaign summary "
                            "(store/<name>/<id>/campaign.json)")
     camp.add_argument("--gen-epoch", default="epoch-v1",
-                      choices=["epoch-v1", "epoch-v2"],
+                      choices=["epoch-v1", "epoch-v2", "epoch-v3"],
                       help="generator epoch (epoch ledger, runner/"
                            "sim.py): epoch-v2 routes every sim run "
                            "through the batched lockstep generator "
                            "(simbatch/) — S seeds per (workload, "
                            "nemesis) cell generated in one columnar "
-                           "pass, histories born as OpColumns; runs "
+                           "pass, histories born as OpColumns; "
+                           "epoch-v3 runs the same cells through the "
+                           "jitted device engine (simbatch/"
+                           "engine_jax.py, jax.random draws, lax.scan "
+                           "drain — MVCC workloads delegate to the "
+                           "epoch-v2 sweep); runs "
                            "the batched generator cannot serve (live "
                            "clusters, unsupported workloads, --stream/"
                            "--soak) fall back to epoch-v1, and every "

@@ -19,7 +19,17 @@ Soundness conventions (every checker rule leans on these):
   never in chains (upper bounds) — unknowns always *widen* intervals.
 - Sessions are process incarnations (jepsen: a crashed process never
   returns), so grouping by the ``proc`` column is the session model,
-  exactly as in checkers/session.py.
+  exactly as in checkers/session.py. The lease model leans on this
+  HARDER than the read rules do: ``_lease_sessions`` closes a proc's
+  held lease at that same proc's next release invoke, which is only
+  sound while proc == session — one incarnation never holds two
+  leases, because a second acquire would have come from a NEW proc
+  (timeouts retire the incarnation). Both sim epochs guarantee this
+  by construction (lease lanes strictly alternate acquire/release,
+  and every timeout bumps the proc); live etcd lease ids carry NO
+  such guarantee (a real client can re-acquire under one process id),
+  so the walk asserts the assumption and raises a diagnostic instead
+  of silently merging two leases into one session span.
 
 Times are the history's own clock (virtual ns in both generator
 epochs); nothing here reads a wall clock.
@@ -307,6 +317,24 @@ def _lease_sessions(cols) -> list:
             if tc[i] == 0:
                 open_inv[p] = t
             elif tc[i] == 1:
+                if p in held:
+                    # proc==session assumption violated: this proc
+                    # acked a second acquire while its first lease was
+                    # still open (no intervening release invoke). True
+                    # in both sim epochs by construction; live etcd
+                    # lease ids can re-acquire under one process id,
+                    # which this model cannot attribute — refuse
+                    # loudly rather than merge two leases into one
+                    # session span (module docstring, soundness
+                    # conventions).
+                    raise ValueError(
+                        "lease session model requires proc==session: "
+                        f"proc {p} acked acquire at row "
+                        f"{int(cols.index[i])} while already holding "
+                        f"a lease (acquired at row {held[p][0]}) — "
+                        "histories with per-process lease re-acquire "
+                        "(live etcd lease ids) need fresh procs per "
+                        "acquire before the MVCC lease checkers apply")
                 inv_t = open_inv.pop(p, t)
                 sess = [int(cols.index[i]), p, inv_t, t, None]
                 held[p] = sess

@@ -78,6 +78,12 @@ DET_WALLCLOCK_ALLOW = (
     "runner/shrink.py",          # artifact mtimes/summary wall only;
                                  # acceptance is signature equality on
                                  # replayed deterministic histories
+    "runner/stream.py",          # streaming/fused-pipeline telemetry:
+                                 # chunk-lag stamps and gen/check busy
+                                 # walls are host accounting only —
+                                 # verdicts come from the bit-identical
+                                 # pack + ladder reuse paths, never the
+                                 # clock
     "db/local.py",
     "db/fake_etcd.py",
     "net/*",            # userspace proxy plane: socket splice loops

@@ -2202,6 +2202,10 @@ def _check_bucket_group(packs: list, results: list, idxs: list,
         kern = _batched_kernel_jitted(f_max, w)
     tables_dev = {k: put(v) for k, v in stacked.items()}
     tel = telemetry.current()
+    for _ in idxs:
+        # every key in the group attempts the batch rung (overflowing
+        # keys then add their per-key ladder climb via check_packed)
+        tel.hist("wgl.rung_waves", f_max)
     with tel.span("wgl.batch-dispatch", keys=K, w=w, f_max=f_max):
         valid, overflow, waves, peak, _frontier = kern(
             tables_dev, put(Rs), put(Is))
@@ -2340,6 +2344,11 @@ def _check_packed_impl(p: Packed, f_max: Optional[int] = None,
     i0 = np.zeros((ladder[0], ni), dtype=np.uint32)
     v0 = np.full((ladder[0],), SENTINEL_V, dtype=np.int32)
     v0[0] = NONE_VAL
+    # one histogram sample per rung ATTEMPT, value = the rung's
+    # frontier budget: log2 buckets give each rung its own bucket, so
+    # bucket counts read as "dispatches that reached this search
+    # depth" (the guided coverage vector's wave-histogram feature)
+    telemetry.current().hist("wgl.rung_waves", ladder[0])
     valid, overflow, k, peak, frontier = _kernel_resume_jitted(
         ladder[0], p.w)(tables, R_, I_, jnp.int32(0),
                         _put(d0), _put(w0),
@@ -2351,6 +2360,7 @@ def _check_packed_impl(p: Packed, f_max: Optional[int] = None,
         if not bool(overflow):
             break
         rungs += 1
+        telemetry.current().hist("wgl.rung_waves", f_next)
         # pad the frozen frontier to the next rung and resume in place
         dvec, wvec, ivec, vvec, n_alive = frontier
         f_cur = dvec.shape[0]
@@ -2474,6 +2484,10 @@ def check_prefix(p: Packed, state: Optional[FrontierState] = None,
         state.k = jnp.int32(0)
         state.peak = 1
         state.rungs = 1
+        # rung ATTEMPT sample (not per budget chunk: a rung entered
+        # once is one search-depth observation however often the wave
+        # budget pauses inside it)
+        telemetry.current().hist("wgl.rung_waves", ladder[0])
     if state.done:
         return state
     p = state.p
@@ -2496,6 +2510,7 @@ def check_prefix(p: Packed, state: Optional[FrontierState] = None,
             state.rungs += 1
             telemetry.current().counter("stream.resume_rungs")
             f_next = state.ladder[state.rung_i]
+            telemetry.current().hist("wgl.rung_waves", f_next)
             dvec, wvec, ivec, vvec, n_alive = frontier
             grow = f_next - dvec.shape[0]
             state.frontier = (

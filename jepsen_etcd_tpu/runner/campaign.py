@@ -376,12 +376,14 @@ def _pool_run(spec: dict) -> dict:
 
 
 def _batchable(opts: dict) -> bool:
-    """True when the batched lockstep generator (simbatch/) can serve
-    this spec: an epoch-v2 sim run of a supported workload. Live
-    clusters produce observed histories (no generator epoch), and
-    --stream/--soak runs interleave generation with the run itself, so
-    all of those fall back to the epoch-v1 event loop."""
-    if opts.get("gen_epoch") != "epoch-v2":
+    """True when the batched generator (simbatch/) can serve this
+    spec: an epoch-v2 (lockstep numpy) or epoch-v3 (jitted device) sim
+    run of a supported workload — generate_for_opts routes between the
+    two engines by the declared epoch. Live clusters produce observed
+    histories (no generator epoch), and --stream/--soak runs
+    interleave generation with the run itself, so all of those fall
+    back to the epoch-v1 event loop."""
+    if opts.get("gen_epoch") not in ("epoch-v2", "epoch-v3"):
         return False
     if opts.get("client_type") in ("http", "grpc"):
         return False

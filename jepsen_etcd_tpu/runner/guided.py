@@ -57,6 +57,15 @@ LATENCIES_MS = (8.0, 16.0, 32.0, 64.0, 128.0)
 #: corpus size cap: lowest-scoring ancestors fall off first
 CORPUS_CAP = 32
 
+#: imported-ancestor aging: ``--corpus-in`` ancestors enter with
+#: ``run: 0`` so cap eviction prefers dropping them on ties, but a
+#: high-scoring ancestor could otherwise dominate mutation draws
+#: forever. Their EFFECTIVE score halves every this-many generations
+#: survived (native corpus entries never decay — they earned their
+#: score in this search), and ancestors decayed below 1 leave the
+#: mutation draw pool entirely.
+IMPORT_HALF_LIFE_GENS = 4
+
 #: feature-vector dimensions folded into the novelty envelope (the
 #: tel_cli.coverage vector keys, reused verbatim). "waves" is BFS
 #: wave depth (wgl.waves, mode=max): histories that force deeper
@@ -100,6 +109,12 @@ class GuidedScheduler:
         self.seen_signatures: dict[str, int] = {}
         self.seen_cells: set = set()
         self.envelope = {dim: 0 for dim in ENVELOPE_DIMS}
+        #: wgl.rung_waves histogram buckets already observed — each
+        #: newly-occupied bucket is a fresh search-depth shape (+1)
+        self.seen_wave_buckets: set = set()
+        #: generation counter: stamps corpus entries (``born``) so
+        #: imported-ancestor decay ages in generations survived
+        self.wave = 0
         self.runs_observed = 0
         self.mutations = 0
         self.crossovers = 0
@@ -109,6 +124,7 @@ class GuidedScheduler:
     def next_generation(self, size: int) -> list:
         """Up to ``size`` opts dicts: pending stratified cells first,
         then mutants/crossovers of corpus ancestors."""
+        self.wave += 1
         out = []
         while self._pending and len(out) < size:
             out.append(self._pending.pop(0))
@@ -130,8 +146,30 @@ class GuidedScheduler:
                      "seed": self._mint_seed()})
         return opts
 
+    def _eff_score(self, c: dict) -> float:
+        """Eviction/draw weight: native entries keep their earned
+        score; imported ancestors decay by half every
+        ``IMPORT_HALF_LIFE_GENS`` generations survived since import."""
+        score = float(c.get("score") or 0)
+        if not c.get("imported"):
+            return score
+        age = max(0, self.wave - int(c.get("born") or 0))
+        return score * 0.5 ** (age // IMPORT_HALF_LIFE_GENS)
+
+    def _evict(self) -> None:
+        if len(self.corpus) > self.corpus_cap:
+            self.corpus.sort(
+                key=lambda c: (-self._eff_score(c), c["run"]))
+            del self.corpus[self.corpus_cap:]
+
     def _pick(self) -> dict:
-        return self.corpus[int(self.rng.integers(len(self.corpus)))]
+        # stale imported ancestors (effective score decayed below 1)
+        # never retire natives from the cap, but they DO stop feeding
+        # mutation draws; an all-stale corpus still draws uniformly
+        pool = [c for c in self.corpus
+                if not c.get("imported") or self._eff_score(c) >= 1.0]
+        pool = pool or self.corpus
+        return pool[int(self.rng.integers(len(pool)))]
 
     def _mutate(self) -> dict:
         rng = self.rng
@@ -259,6 +297,7 @@ class GuidedScheduler:
             "envelope": dict(self.envelope),
             "signatures": dict(self.seen_signatures),
             "cells": sorted([w, list(n)] for w, n in self.seen_cells),
+            "wave_buckets": sorted(self.seen_wave_buckets),
             "corpus": [dict(c) for c in self.corpus],
         }
 
@@ -286,6 +325,8 @@ class GuidedScheduler:
         for cell in data.get("cells") or ():
             if isinstance(cell, (list, tuple)) and len(cell) == 2:
                 self.seen_cells.add((cell[0], tuple(cell[1] or ())))
+        for b in data.get("wave_buckets") or ():
+            self.seen_wave_buckets.add(int(b))
         added = 0
         for c in data.get("corpus") or ():
             if not (isinstance(c, dict) and isinstance(c.get("opts"),
@@ -301,11 +342,13 @@ class GuidedScheduler:
                                     .get(dim) or 0)
                            for dim in ENVELOPE_DIMS},
                 "imported": True,
+                # decay clock starts at the CURRENT wave: an ancestor
+                # ages by generations survived here, not by how old
+                # the exporting campaign was
+                "born": self.wave,
             })
             added += 1
-        if len(self.corpus) > self.corpus_cap:
-            self.corpus.sort(key=lambda c: (-c["score"], c["run"]))
-            del self.corpus[self.corpus_cap:]
+        self._evict()
         return added
 
     # -- scoring ------------------------------------------------------
@@ -332,6 +375,15 @@ class GuidedScheduler:
             if v > self.envelope[dim]:
                 self.envelope[dim] = v
                 score += 1
+        # search-depth SHAPE, not just envelope peaks: each
+        # wgl.rung_waves histogram bucket (one per ladder rung) first
+        # occupied by this run is novel — a history that makes many
+        # dispatches settle at a new rung scores even when the deepest
+        # rung (the "rungs" envelope dim) has been seen before
+        for b in vector.get("wave_hist") or {}:
+            if int(b) not in self.seen_wave_buckets:
+                self.seen_wave_buckets.add(int(b))
+                score += 1
         if cell not in self.seen_cells:
             self.seen_cells.add(cell)
             score += 1
@@ -342,10 +394,9 @@ class GuidedScheduler:
                 "signature": sig,
                 "vector": {dim: int(vector.get(dim) or 0)
                            for dim in ENVELOPE_DIMS},
+                "born": self.wave,
             })
-            if len(self.corpus) > self.corpus_cap:
-                self.corpus.sort(key=lambda c: (-c["score"], c["run"]))
-                del self.corpus[self.corpus_cap:]
+            self._evict()
         return score
 
 

@@ -34,6 +34,20 @@ here, not editing an old one.
   *verdict* equality across workload × nemesis (histories differ
   op-by-op across epochs — that is the point of declaring an epoch —
   but checker verdicts must not).
+- **epoch-v3** (``simbatch/engine_jax.py``, the jitted device
+  generator): same ``(time, lane, seq)`` ordering rule as epoch-v2 —
+  lane-residue times keep per-seed event times unique, so the heap's
+  pop sequence materializes as one argsort and the register/set step
+  machines run as a ``jax.lax.scan`` on device. Random blocks come
+  from ``jax.random`` (threefry) under a per-seed
+  ``PRNGKey(seed mod 2**32)`` with a fixed 12-way subkey split (draw
+  order/shapes/dtypes declared in engine_jax.py), so histories differ
+  from epoch-v2 draw-by-draw; the MVCC workloads delegate to the
+  epoch-v2 per-seed sweep and are bit-identical to it. The 16-seed
+  golden-hash pin in tests/test_simbatch_jax.py freezes epoch-v3
+  serialization, and the cross-epoch verdict fuzz extends to
+  register/set × none/kill/partition against BOTH epoch-v1 and
+  epoch-v2.
 
 Runs record their generator epoch (campaign.json ``gen-epoch`` per
 row), so stored histories always re-check against the rule that

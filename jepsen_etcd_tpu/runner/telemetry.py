@@ -87,6 +87,9 @@ REGISTRY = {
         "campaign.sweep",     # runner/campaign.py: the whole pool pass
         "service.tick",       # runner/checker_service.py: one coalesced
                               # device dispatch window
+        "fused.gen",          # runner/stream.py FusedPipeline: the
+                              # producer's generation leg
+        "fused.check",        # ... and the consumer's check leg
     ),
     "hists": (
         "op.latency.*",       # per-op-class completion latency, seconds
@@ -99,6 +102,12 @@ REGISTRY = {
                                   # in the service reply
         "stream.chunk_lag_s",  # enqueue->consume delay per chunk,
                                # runner/stream.py
+        "wgl.rung_waves",      # one sample per ladder-rung attempt,
+                               # value = rung frontier budget — log2
+                               # buckets put each rung in its own
+                               # bucket, so counts read as search-depth
+                               # shape (ops/wgl.py; the guided coverage
+                               # vector's wave-histogram feature)
     ),
     "counters": (
         "generate.ops_per_s",
@@ -223,6 +232,14 @@ REGISTRY = {
         "genbatch.ops_per_s",     # aggregate events per generation wall
                                   # second across the batch (mode=max)
         "genbatch.compactions",   # BatchHeap tombstone compactions
+        "fused.seeds",            # runner/stream.py FusedPipeline:
+                                  # seeds generated+checked through the
+                                  # overlapped gen->check pipeline
+        "fused.packs",            # per-key packs checked by the
+                                  # pipeline's consumer leg
+        "fused.waves",            # total check_prefix waves the
+                                  # consumer advanced while the
+                                  # producer was still generating
         "live.records",           # campaign LiveCollector: records
                                   # received over the live socket
         "live.dropped",           # records shed by the bounded queue

@@ -35,6 +35,11 @@ from .engine import (  # noqa: F401
     schedule_span,
     supports,
 )
+from .engine_jax import (  # noqa: F401
+    GEN_EPOCH_V3,
+    default_schedule_jax,
+    generate_jax,
+)
 from .heap import DONE, BatchHeap  # noqa: F401
 
 

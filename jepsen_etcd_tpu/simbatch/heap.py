@@ -35,6 +35,11 @@ DONE = np.int64(2) ** 62
 
 EPOCH_V1 = "epoch-v1"
 EPOCH_V2 = "epoch-v2"
+#: jitted device engine (engine_jax.py). Same ``(time, lane, seq)``
+#: ordering rule as epoch-v2 — the drain order is materialized as one
+#: argsort over lane-residue-unique times instead of popped
+#: incrementally, identical by the unique-times argument above.
+EPOCH_V3 = "epoch-v3"
 
 #: lane id bit-position in the epoch-v2 ordinal; seq occupies the low
 #: bits, so lanes must fit in the remaining headroom
@@ -55,7 +60,7 @@ class BatchHeap:
     def __init__(self, n_seeds: int, capacity: int = 8,
                  epoch: str = EPOCH_V2, auto_compact: int = 16,
                  unique_times: bool = False):
-        if epoch not in (EPOCH_V1, EPOCH_V2):
+        if epoch not in (EPOCH_V1, EPOCH_V2, EPOCH_V3):
             raise ValueError(f"unknown generator epoch {epoch!r}")
         self.S = int(n_seeds)
         self.capacity = max(2, int(capacity))

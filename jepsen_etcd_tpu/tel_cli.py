@@ -338,8 +338,17 @@ def coverage(path: str) -> dict:
             continue
         if not isinstance(results, dict):
             continue
-        ctr = ((results.get("telemetry") or {}).get("counters")
-               or {})
+        tel_sum = results.get("telemetry") or {}
+        ctr = tel_sum.get("counters") or {}
+        # per-rung dispatch shape: the wgl.rung_waves histogram puts
+        # each ladder rung in its own log2 bucket, so
+        # {bucket: dispatches} IS the search-depth distribution —
+        # guided novelty scores newly-occupied buckets (+1 each)
+        wave_hist = {
+            int(b): int(c)
+            for b, c in (((tel_sum.get("hists") or {})
+                          .get("wgl.rung_waves") or {})
+                         .get("buckets") or {}).items()}
         runs.append({"dir": rdir,
                      "valid": results.get("valid?"),
                      "frontier": int(ctr.get("wgl.max-frontier", 0)),
@@ -349,8 +358,12 @@ def coverage(path: str) -> dict:
                      # mode=max counter): a depth dimension the width
                      # features above can't see
                      "waves": int(ctr.get("wgl.waves", 0)),
+                     "wave_hist": wave_hist,
                      "signature": _failure_signature(results)})
     sigs = Counter(r["signature"] for r in runs if r["signature"])
+    buckets: Counter = Counter()
+    for r in runs:
+        buckets.update(r["wave_hist"])
     agg = {"count": len(runs),
            "peak_frontier": max((r["frontier"] for r in runs),
                                 default=0),
@@ -359,6 +372,7 @@ def coverage(path: str) -> dict:
            "spills": sum(r["spills"] for r in runs),
            "invalid": sum(1 for r in runs
                           if r["valid"] is not True),
+           "wave_hist": dict(sorted(buckets.items())),
            "signatures": dict(sorted(sigs.items()))}
     if rows_meta is not None:
         agg["rows"] = len(rows_meta)

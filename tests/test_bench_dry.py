@@ -78,6 +78,23 @@ def test_dry_gen_throughput_cell(dry_all):
     batched = cell["batched"]
     assert batched["seeds"] == 16
     assert batched["events"] > 0 and batched["steps"] > 0
+    jitted = cell["jitted"]
+    assert jitted["seeds"] == 16
+    assert jitted["events"] > 0
+
+
+def test_dry_fused_pipeline_cell(dry_all):
+    """Tier-1 guard on the fused cell's structure: every seed gets a
+    verdict, the verdict map matches the sequential twin (asserted
+    inside the dry check itself), and pack/wave accounting is live —
+    the e2e/max ratio is only measured by the real bench run."""
+    cell = dry_all["fused_pipeline"]
+    assert cell["ok"] is True and cell["check"] == "_dry_fused_pipeline"
+    assert cell["seeds"] == 4
+    assert cell["packs"] >= 4
+    assert cell["waves"] > 0
+    assert sorted(cell["verdicts"]) == ["0", "1", "2", "3"] or \
+        sorted(cell["verdicts"]) == [0, 1, 2, 3]
 
 
 def test_dry_streaming_cell(dry_all):

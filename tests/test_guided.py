@@ -134,6 +134,130 @@ def test_corpus_export_import_roundtrip():
         s2.import_corpus({"kind": "something-else"})
 
 
+def _corpus_entry(run, score, **extra):
+    return dict({"opts": dict(BASE, nemesis=["kill"], seed=run),
+                 "seed": run, "run": run, "score": score,
+                 "signature": "", "vector": {}}, **extra)
+
+
+def test_imported_ancestors_age_out_of_mutation_draws():
+    """Generation-stamped decay (ISSUE 19 satellite): an imported
+    ancestor's effective score halves every IMPORT_HALF_LIFE_GENS
+    generations, so a stale import stops feeding _pick; natives never
+    decay, and an all-stale corpus still draws uniformly."""
+    import pytest as _pytest
+    from jepsen_etcd_tpu.runner.guided import IMPORT_HALF_LIFE_GENS
+
+    s = GuidedScheduler(BASE, ["register"], CELLS, seed0=0,
+                        master_seed=5)
+    imp = _corpus_entry(1, 1.5, imported=True, born=0)
+    nat = _corpus_entry(2, 1.0)
+    s.corpus[:] = [imp, nat]
+    assert s._eff_score(imp) == 1.5, "no decay before a half-life"
+    for _ in range(IMPORT_HALF_LIFE_GENS):
+        s.next_generation(1)
+    assert s._eff_score(imp) == _pytest.approx(0.75)
+    assert s._eff_score(nat) == 1.0, "natives must never decay"
+    # effective score < 1 drops the import from the draw pool
+    assert {id(s._pick()) for _ in range(32)} == {id(nat)}
+    s.corpus[:] = [imp]
+    assert s._pick() is imp, "all-stale corpus must not starve"
+
+
+def test_eviction_prefers_live_natives_over_stale_imports():
+    """The cap sorts by effective (decayed) score: a once-dominant
+    import with the highest RAW score is evicted once fresher native
+    entries out-score its decayed weight."""
+    from jepsen_etcd_tpu.runner.guided import IMPORT_HALF_LIFE_GENS
+
+    s = GuidedScheduler(BASE, ["register"], CELLS, seed0=0,
+                        master_seed=5, corpus_cap=2)
+    imp = _corpus_entry(1, 8.0, imported=True, born=0)
+    s.corpus[:] = [imp]
+    for _ in range(2 * IMPORT_HALF_LIFE_GENS):
+        s.next_generation(1)
+    assert s._eff_score(imp) == 2.0
+    s.corpus.extend([_corpus_entry(2, 4.0), _corpus_entry(3, 3.0)])
+    s._evict()
+    assert imp not in s.corpus and len(s.corpus) == 2
+
+
+def test_import_stamps_born_and_roundtrips_wave_buckets():
+    """Imports start their decay clock at the CURRENT wave (age 0 on
+    arrival, whatever the exporter's history), and the exporter's
+    occupied wave-histogram buckets stop scoring as novel."""
+    s = GuidedScheduler(BASE, ["register"], CELLS, seed0=7,
+                        master_seed=7)
+    row = {"status": "done", "valid": False, "workload": "register",
+           "nemesis": ["kill"], "seed": 2}
+    vec = {"frontier": 3, "waves": 2, "rungs": 1, "spills": 0,
+           "signature": "workload=False", "wave_hist": {24: 9, 26: 1}}
+    assert s.observe(dict(BASE, nemesis=["kill"]), row, vec) > 0
+    assert s.corpus[0]["born"] == s.wave
+    data = json.loads(json.dumps(s.export_corpus()))
+    assert data["wave_buckets"] == [24, 26]
+
+    s2 = GuidedScheduler(BASE, ["register"], CELLS, seed0=7,
+                         master_seed=11)
+    for _ in range(3):
+        s2.next_generation(1)
+    assert s2.import_corpus(data) == 1
+    assert s2.corpus[0]["imported"]
+    assert s2.corpus[0]["born"] == s2.wave == 3
+    assert s2._eff_score(s2.corpus[0]) == s2.corpus[0]["score"]
+    assert s2.seen_wave_buckets == {24, 26}
+    # the imported buckets are no longer novel to the warmed search
+    row2 = dict(row, seed=3)
+    assert s2.observe(dict(BASE, nemesis=["kill"]), row2,
+                      dict(vec)) == 0
+
+
+def test_wave_hist_buckets_score_search_depth_shape():
+    """Each newly-occupied wgl.rung_waves bucket scores +1 — depth
+    SHAPE novelty, independent of the envelope peaks — and an
+    already-seen bucket scores nothing (string keys tolerated: the
+    vector arrives through JSON)."""
+    s = GuidedScheduler(BASE, ["register"], CELLS, seed0=0,
+                        master_seed=3)
+    ok = {"status": "done", "valid": True, "workload": "register",
+          "nemesis": ["kill"], "seed": 2}
+    base_vec = {"frontier": 1, "rungs": 0, "spills": 0}
+    first = s.observe(dict(BASE), ok, dict(base_vec,
+                                           wave_hist={24: 9}))
+    assert first > 0 and 24 in s.seen_wave_buckets
+    # same cell, same bucket, nothing else novel: zero
+    assert s.observe(dict(BASE), dict(ok, seed=3),
+                     dict(base_vec, wave_hist={"24": 2})) == 0
+    # one fresh bucket alone is worth exactly one point
+    assert s.observe(dict(BASE), dict(ok, seed=4),
+                     dict(base_vec, wave_hist={26: 1})) == 1
+    assert s.seen_wave_buckets == {24, 26}
+
+
+def test_coverage_surfaces_wave_histogram(tmp_path):
+    """tel --coverage lifts each run's wgl.rung_waves buckets into its
+    row and sums them into the aggregate (int keys, sorted)."""
+    from jepsen_etcd_tpu.tel_cli import coverage
+
+    def fake_run(name, hists):
+        rdir = tmp_path / name
+        rdir.mkdir(parents=True)
+        (rdir / "results.json").write_text(json.dumps(
+            {"valid?": True,
+             "telemetry": {"counters": {"wgl.max-frontier": 2},
+                           "hists": hists}}))
+
+    fake_run("0001", {"wgl.rung_waves": {"buckets": {"24": 5,
+                                                     "26": 1}}})
+    fake_run("0002", {"wgl.rung_waves": {"buckets": {"24": 2}}})
+    fake_run("0003", {})  # no histogram recorded: empty, not an error
+    out = coverage(str(tmp_path))
+    by_dir = {r["dir"]: r for r in out["runs"]}
+    assert by_dir[str(tmp_path / "0001")]["wave_hist"] == {24: 5, 26: 1}
+    assert by_dir[str(tmp_path / "0003")]["wave_hist"] == {}
+    assert out["aggregate"]["wave_hist"] == {24: 7, 26: 1}
+
+
 def test_param_mutation_hops_within_pools():
     """The param dimension only hops along its declared pools — one
     parameter per mutation, always to a pool value."""

@@ -163,3 +163,46 @@ def test_aggregate_grows_consistency_surface_column(tmp_path):
     assert "consistency" in page
     assert "stale&nbsp;" in page and "(3)" in page
     assert "lease&nbsp;" in page
+
+
+# -- proc==session lease assumption (ISSUE 19 satellite) --------------------
+
+
+def _lease_cols(rows):
+    """Hand-built OpColumns over an acquire/release f_table; rows are
+    (type_code, proc, f_name, time)."""
+    import numpy as np
+
+    from jepsen_etcd_tpu.core.history import OpColumns
+
+    ft = ["acquire", "release"]
+    n = len(rows)
+    return OpColumns(
+        np.array([r[0] for r in rows], np.int8),
+        np.array([ft.index(r[2]) for r in rows], np.int32),
+        np.array([r[1] for r in rows], np.int64),
+        np.zeros(n, np.int64),
+        np.array([r[3] for r in rows], np.int64),
+        np.arange(n), [None] * n, {}, {}, ft, ["k"], [])
+
+
+def test_lease_sessions_assert_proc_is_session():
+    """The lease walk's load-bearing assumption (core/mvcc.py
+    docstring): one proc never holds two leases. The legitimate
+    acquire/release alternation both sim epochs emit walks fine; a
+    same-proc re-acquire — what a live etcd lease id can do — raises
+    the diagnostic instead of silently merging two leases into one
+    session span."""
+    from jepsen_etcd_tpu.core.mvcc import _lease_sessions
+
+    ok = _lease_cols([(0, 0, "acquire", 1), (1, 0, "acquire", 2),
+                      (0, 0, "release", 3), (0, 0, "acquire", 4),
+                      (1, 0, "acquire", 5)])
+    sess = _lease_sessions(ok)
+    assert [s[1] for s in sess] == [0, 0]
+    assert sess[0][4] == 3 and sess[1][4] is None
+
+    bad = _lease_cols([(0, 0, "acquire", 1), (1, 0, "acquire", 2),
+                       (0, 0, "acquire", 3), (1, 0, "acquire", 4)])
+    with pytest.raises(ValueError, match="proc==session"):
+        _lease_sessions(bad)

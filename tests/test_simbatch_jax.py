@@ -1,0 +1,176 @@
+"""The jitted device-side generator (simbatch/engine_jax.py, epoch-v3):
+the 16-seed golden-hash pin freezing the v3 ledger entry, determinism
+and batch-composition independence, the drawn-vs-explicit schedule
+replay contract, MVCC delegation to the epoch-v2 per-seed sweep, the
+stale-read injection surviving the port, and the cross-epoch
+verdict-equality fuzz against BOTH epoch-v1 (live interpreter) and
+epoch-v2 (numpy lockstep engine).
+
+The golden hashes pin the epoch-v3 draw contract (threefry
+``PRNGKey(seed mod 2**32)`` split 12 ways, the int/float scaling rules
+in engine_jax's module docstring) AND the shared ``BatchConfig.
+from_opts`` sizing: an intentional change to either must bump the
+generator epoch (the ledger in runner/sim.py) and re-pin here in the
+same commit — never re-pin under epoch-v3.
+"""
+
+import hashlib
+
+import pytest
+
+from jepsen_etcd_tpu.simbatch import (GEN_EPOCH_V2, GEN_EPOCH_V3,
+                                      BatchConfig, default_schedule_jax,
+                                      generate, generate_for_opts,
+                                      generate_jax, history_sha)
+
+# ---- the 16-seed golden pin ------------------------------------------------
+
+#: same shape as test_simbatch.GOLDEN_OPTS / bench _dry_gen_jitted,
+#: routed through the v3 engine
+GOLDEN_OPTS = {"workload": "register", "nodes": ["n1", "n2", "n3"],
+               "concurrency": 8, "rate": 200.0, "time_limit": 2.0,
+               "gen_epoch": "epoch-v3"}
+
+GOLDEN_SEED0 = \
+    "c82fabd17a19636bd2aa710d219ff7da169d8919b4528566d55fd41e63853fb8"
+GOLDEN_JOINED = \
+    "d93dbf74fe3c0a4180282278c2c223293b7779e33edbdd4bb5a2798c43f9693c"
+
+
+def test_golden_hash_16_seed_pin_v3():
+    """Epoch-v3 is pinned: these 16 histories must serialize to these
+    exact bytes on every platform (threefry is platform-stable by
+    design; the host-side scaling arithmetic is pure int64). A failure
+    here means either an engine bug or a contract change that REQUIRES
+    a new generator epoch."""
+    g = generate_for_opts(dict(GOLDEN_OPTS), range(16))
+    assert g["epoch"] == GEN_EPOCH_V3
+    shas = [history_sha(h) for h in g["histories"]]
+    assert shas[0] == GOLDEN_SEED0
+    joined = hashlib.sha256("".join(shas).encode()).hexdigest()
+    assert joined == GOLDEN_JOINED
+    assert len(set(shas)) == 16, "distinct seeds collapsed"
+
+
+# ---- determinism + composition independence --------------------------------
+
+
+def test_jitted_deterministic_and_composition_independent():
+    cfg = BatchConfig(workload="register", lanes=4, ops_per_lane=30,
+                      rate=500.0)
+    g1 = generate_jax(cfg, [3, 5, 7])
+    # born-columnar must be asserted BEFORE hashing: history_sha's
+    # to_jsonl is the declared dict-materializing exception
+    for h in g1["histories"]:
+        assert h._ops is None, "jitted generation materialized dicts"
+        assert len(h.columns) == len(h) > 0
+    g2 = generate_jax(cfg, [3, 5, 7])
+    s1 = [history_sha(h) for h in g1["histories"]]
+    assert s1 == [history_sha(h) for h in g2["histories"]]
+    # a seed's history is a pure function of (seed, config): the
+    # per-seed key split means batch membership must not matter
+    solo = generate_jax(cfg, [5])
+    assert history_sha(solo["histories"][0]) == s1[1]
+    assert g1["epoch"] == GEN_EPOCH_V3
+
+
+# ---- drawn-vs-explicit schedule replay -------------------------------------
+
+
+@pytest.mark.parametrize("nemesis", [["kill"], ["partition"],
+                                     ["kill", "partition"]],
+                         ids=lambda n: "+".join(n))
+def test_explicit_schedule_replays_drawn_plan_v3(nemesis):
+    """The shrink determinism contract holds for the jitted engine:
+    materializing a run's drawn fault plan (``default_schedule_jax``)
+    as an explicit window list — singly or as a batched same-seed
+    population — changes NOTHING about the history."""
+    opts = {"workload": "register", "nodes": ["n1", "n2", "n3"],
+            "concurrency": 6, "rate": 100.0, "time_limit": 1.0,
+            "nemesis": nemesis}
+    cfg = BatchConfig.from_opts(opts)
+    for seed in (7, 12):
+        drawn = generate_jax(cfg, [seed])["histories"][0]
+        sched = default_schedule_jax(cfg, seed)
+        assert len(sched) >= 1
+        explicit = generate_jax(cfg, [seed],
+                                nem_schedules=[sched])["histories"][0]
+        pop = generate_jax(cfg, [seed] * 3,
+                           nem_schedules=[sched] * 3)["histories"]
+        sha = history_sha(drawn)
+        assert history_sha(explicit) == sha
+        assert all(history_sha(h) == sha for h in pop)
+
+
+# ---- MVCC delegation -------------------------------------------------------
+
+
+def test_mvcc_workloads_delegate_bit_identically_to_v2():
+    """The v3 ledger entry declares MVCC workloads delegate to the
+    epoch-v2 per-seed sweep: rows bit-identical, only the epoch label
+    differs (so MVCC injections keep working untouched)."""
+    opts = {"workload": "ranges", "nodes": ["n1", "n2", "n3"],
+            "concurrency": 6, "rate": 100.0, "time_limit": 1.0}
+    cfg = BatchConfig.from_opts(opts)
+    v2 = generate(cfg, [4, 9])
+    v3 = generate_jax(cfg, [4, 9])
+    assert v2["epoch"] == GEN_EPOCH_V2
+    assert v3["epoch"] == GEN_EPOCH_V3
+    assert [history_sha(h) for h in v2["histories"]] == \
+        [history_sha(h) for h in v3["histories"]]
+
+
+# ---- stale-read injection survives the port --------------------------------
+
+
+def test_stale_injection_caught_by_session_checker_v3():
+    """The seeded stale-read bug flips the session-guarantee verdict
+    through the jitted path too; clean v3 generation stays green."""
+    from jepsen_etcd_tpu.workloads.register import workload as reg_wl
+
+    wopts = {"nodes": ["n1", "n2", "n3"], "concurrency": 6}
+    chk = reg_wl(wopts)["checker"]
+    mk = dict(workload="register", lanes=6, ops_per_lane=60, rate=500.0)
+    clean = generate_jax(BatchConfig(**mk), range(3))
+    stale = generate_jax(BatchConfig(inject_stale_reads=True, **mk),
+                         range(3))
+    for h in clean["histories"]:
+        assert chk.check(dict(wopts), h)["valid?"] is True
+    flipped = [chk.check(dict(wopts), h)["valid?"] is False
+               for h in stale["histories"]]
+    assert all(flipped), flipped
+
+
+# ---- verdict-equality fuzz: epoch-v3 vs BOTH v1 and v2 ---------------------
+
+#: histories differ across epochs by design (different draw streams);
+#: the contract is verdict equality — register/set x none/kill/
+#: partition, each cell checked through all three generators
+FUZZ_CELLS = [("register", []), ("register", ["kill"]),
+              ("register", ["partition"]),
+              ("set", []), ("set", ["kill"]), ("set", ["partition"])]
+
+
+@pytest.mark.parametrize("workload,nemesis", FUZZ_CELLS,
+                         ids=[f"{w}-{'+'.join(n) or 'none'}"
+                              for w, n in FUZZ_CELLS])
+def test_verdict_equality_v3_vs_v1_and_v2(tmp_path, workload, nemesis):
+    from jepsen_etcd_tpu.compose import etcd_test
+    from jepsen_etcd_tpu.runner.test_runner import run_test
+
+    seed = 11
+    opts = {"workload": workload, "nemesis": list(nemesis),
+            "nodes": ["n1", "n2", "n3"], "concurrency": 8,
+            "rate": 200.0, "time_limit": 2, "seed": seed,
+            "store_base": str(tmp_path), "no_telemetry": True}
+    v1 = run_test(etcd_test(dict(opts)))["valid?"]
+    verdicts = {"v1": v1}
+    for label, epoch in (("v2", "epoch-v2"), ("v3", "epoch-v3")):
+        g = generate_for_opts(dict(opts, gen_epoch=epoch), [seed])
+        test = etcd_test(dict(opts))
+        d = tmp_path / f"{label}-{workload}-{seed}"
+        d.mkdir(exist_ok=True)
+        verdicts[label] = test["checker"].check(
+            test, g["histories"][0], {"store_dir": str(d)})["valid?"]
+    assert verdicts["v1"] == verdicts["v2"] == verdicts["v3"] == True, \
+        (workload, nemesis, seed, verdicts)  # noqa: E712

@@ -109,6 +109,136 @@ def test_check_prefix_trivial_and_unpackable():
     assert state.result["reason"] == "delegated"
 
 
+# ---- adversarial chunk boundaries (ISSUE 19 satellite) ---------------------
+#
+# The fused pipeline's consumer packs each history by feeding
+# _slice_columns row windows through a PackStream. Its soundness
+# argument is that chunk boundaries are INVISIBLE: however the row
+# stream is cut — including between an op's invoke and its completion,
+# the worst case for any packer holding per-process open-op state —
+# the per-key packs and every check_prefix pause along the frontier's
+# trajectory are bit-identical to the one-shot run.
+
+
+def _fused_history(seed=5):
+    from jepsen_etcd_tpu.simbatch import BatchConfig, generate_jax
+    cfg = BatchConfig(workload="register", lanes=6, ops_per_lane=40,
+                      rate=500.0, keys=2)
+    return generate_jax(cfg, [seed])["histories"][0]
+
+
+def _pack_split(cols, bounds):
+    """Pack a column stream cut at the given row offsets."""
+    from jepsen_etcd_tpu.runner.stream import _slice_columns
+    ps = wgl.PackStream()
+    cuts = [0] + sorted(set(bounds)) + [len(cols)]
+    for lo, hi in zip(cuts, cuts[1:]):
+        if hi > lo:
+            ps.feed(_slice_columns(cols, lo, hi))
+    packs = ps.finish()
+    assert packs is not None and ps.ok
+    return packs
+
+
+def _mid_window_cuts(cols):
+    """Row offsets that each split some op's invoke from its
+    completion: cut right after every 7th invoke whose matching
+    completion lies strictly later in the stream."""
+    open_rows = {}
+    pairs = []
+    for i in range(len(cols)):
+        p = int(cols.proc[i])
+        if int(cols.type_code[i]) == 0:          # invoke
+            open_rows[p] = i
+        elif p in open_rows:
+            pairs.append((open_rows.pop(p), i))
+    cuts = [inv + 1 for inv, comp in pairs if comp > inv + 1]
+    assert cuts, "history has no spanning invoke windows"
+    return cuts[::7] or cuts[:1]
+
+
+def _prefix_trajectory(p, max_waves):
+    """Every pause point of a budgeted run: (k, rung, rungs, waves,
+    frontier-bytes) per step, plus the finished state."""
+    import hashlib
+
+    import numpy as np
+
+    def snap(state):
+        fr = b"".join(np.asarray(x).tobytes() for x in state.frontier) \
+            if getattr(state, "frontier", None) is not None else b""
+        return (int(state.k) if not state.done else None,
+                state.rungs, state.waves_run,
+                hashlib.sha256(fr).hexdigest())
+
+    state = wgl.check_prefix(p, None, max_waves=max_waves)
+    traj = [snap(state)]
+    steps = 1
+    while not state.done:
+        state = wgl.check_prefix(p, state, max_waves=max_waves)
+        traj.append(snap(state))
+        steps += 1
+        assert steps < 100_000, "check_prefix failed to converge"
+    return traj, state
+
+
+def test_packstream_chunk_boundaries_are_invisible():
+    """Every cut pattern — one row per chunk, prime-width chunks, and
+    cuts deliberately splitting invoke windows — yields per-key packs
+    bit-identical to the one-shot feed."""
+    import dataclasses
+
+    import numpy as np
+    from jepsen_etcd_tpu.runner.stream import _slice_columns
+
+    cols = _fused_history().columns
+    ps = wgl.PackStream()
+    ps.feed(_slice_columns(cols, 0, len(cols)))
+    ref = ps.finish()
+    assert ref is not None and ps.ok
+    n = len(cols)
+    patterns = {"per-row": list(range(1, n)),
+                "prime": list(range(13, n, 13)),
+                "mid-window": _mid_window_cuts(cols)}
+    for name, bounds in patterns.items():
+        packs = _pack_split(cols, bounds)
+        assert sorted(packs) == sorted(ref), name
+        for key, pk in packs.items():
+            wgl.ensure_frames(pk)
+            wgl.ensure_frames(ref[key])
+            for fld in dataclasses.fields(type(pk)):
+                x = getattr(pk, fld.name)
+                y = getattr(ref[key], fld.name)
+                if isinstance(x, np.ndarray) or \
+                        isinstance(y, np.ndarray):
+                    assert np.array_equal(x, y), (name, key, fld.name)
+                else:
+                    assert x == y, (name, key, fld.name)
+
+
+def test_check_prefix_resume_under_adversarial_boundaries():
+    """The full fused-consumer leg: packs built from mid-invoke-window
+    chunk cuts drive check_prefix through identical frontier
+    trajectories — every pause's k, rung count and frontier bytes —
+    as packs from the unsplit stream, at every wave budget."""
+    from jepsen_etcd_tpu.runner.stream import _slice_columns
+
+    cols = _fused_history(seed=9)
+    cols = cols.columns
+    ps = wgl.PackStream()
+    ps.feed(_slice_columns(cols, 0, len(cols)))
+    ref_packs = ps.finish()
+    assert ref_packs is not None
+    split_packs = _pack_split(cols, _mid_window_cuts(cols))
+    for key in sorted(ref_packs):
+        for budget in BUDGETS:
+            t_ref, s_ref = _prefix_trajectory(ref_packs[key], budget)
+            t_spl, s_spl = _prefix_trajectory(split_packs[key], budget)
+            assert t_ref == t_spl, (key, budget)
+            assert _strip_result(s_ref.result) == \
+                _strip_result(s_spl.result), (key, budget)
+
+
 def _op(i, type, process, f, value, error=None):
     d = dict(type=type, process=process, f=f, value=value,
              time=i * 10, index=i)
