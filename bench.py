@@ -1384,6 +1384,99 @@ def bench_mvcc_surfaces():
             "per_workload": per}
 
 
+def _synth_store(base, n, start=0, fail_every=7):
+    """Write ``n`` tiny synthetic runs under ``base`` (the two-level
+    ``<store>/<test>/<run>`` layout save_run produces): results.json +
+    test.json + a one-line history.jsonl each, a failing verdict every
+    ``fail_every``-th run so the aggregate's failure table and the
+    coverage signatures are non-trivial."""
+    import json as _json
+    import os
+    os.makedirs(base, exist_ok=True)
+    for i in range(start, start + n):
+        tname = f"synth-{i % 5}"
+        rdir = os.path.join(base, tname, f"{i:05d}")
+        os.makedirs(rdir)
+        failed = bool(fail_every) and i % fail_every == 0
+        results = {
+            "valid?": not failed,
+            "stats": {"count": 100 + i},
+            "workload": {"valid?": not failed},
+            "telemetry": {
+                "phases": {"generate": 0.5, "check": 0.25},
+                "counters": {"generate.ops_per_s": 1000.0 + i,
+                             "wgl.max-frontier": 4 + i % 3,
+                             "wgl.rungs": 2, "wgl.waves": 3,
+                             "wgl.host-spill": i % 2},
+            },
+        }
+        test = {"name": tname, "workload": "register",
+                "nemesis": ["kill"] if i % 2 else ["partition"],
+                "db_mode": "sim", "time_limit": 5, "seed": i}
+        with open(os.path.join(rdir, "results.json"), "w") as f:
+            _json.dump(results, f)
+        with open(os.path.join(rdir, "test.json"), "w") as f:
+            _json.dump(test, f)
+        with open(os.path.join(rdir, "history.jsonl"), "w") as f:
+            f.write('{"type": "invoke", "f": "write", "value": 1}\n')
+
+
+def bench_store_index():
+    """Indexed-store serving cell: warm ``/aggregate`` latency must
+    stay flat (within the ±2x acceptance bar) from 100 to 10k runs —
+    the fold replays only rows past its high-water mark and the render
+    cache keys off the index generation, so a warm request pays two
+    stats and a dict lookup regardless of store size."""
+    import os
+    import shutil
+    import tempfile
+    from jepsen_etcd_tpu import serve
+    from jepsen_etcd_tpu.runner import store_index
+
+    sizes = (100, 10_000)
+    walls = {}
+    calls = 200  # amortize the sub-ms warm path over a batch
+    for n in sizes:
+        tmp = tempfile.mkdtemp(prefix=f"bench-idx-{n}-")
+        try:
+            t0 = time.time()
+            _synth_store(tmp, n)
+            synth_s = time.time() - t0
+            t0 = time.time()
+            store_index.rebuild(tmp)
+            rebuild_s = time.time() - t0
+            t0 = time.time()
+            page = serve.aggregate_html(tmp, page=1, per=50)
+            cold_s = time.time() - t0  # fold + full render, once
+            assert f"{n} runs" in page, "aggregate lost runs"
+            batches = []
+            for _ in range(5):
+                t0 = time.time()
+                for _ in range(calls):
+                    serve.aggregate_html(tmp, page=1, per=50)
+                batches.append((time.time() - t0) / calls)
+            walls[n] = {"warm_s": sorted(batches)[len(batches) // 2],
+                        "cold_s": cold_s, "rebuild_s": rebuild_s,
+                        "synth_s": synth_s}
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+            serve._AGG_CACHE.clear()
+            store_index._FOLDS.clear()
+    ratio = walls[10_000]["warm_s"] / max(walls[100]["warm_s"], 1e-9)
+    note(f"store-index: warm /aggregate "
+         f"{walls[100]['warm_s'] * 1e6:.0f}us @100 vs "
+         f"{walls[10_000]['warm_s'] * 1e6:.0f}us @10k "
+         f"({ratio:.2f}x; cold render {walls[10_000]['cold_s']:.2f}s, "
+         f"rebuild {walls[10_000]['rebuild_s']:.2f}s)")
+    assert ratio <= 2.0, \
+        f"warm /aggregate not flat 100 -> 10k: {ratio:.2f}x"
+    return {"value": round(ratio, 3), "unit": "x_100_to_10k",
+            "warm_us_100": round(walls[100]["warm_s"] * 1e6, 1),
+            "warm_us_10k": round(walls[10_000]["warm_s"] * 1e6, 1),
+            "cold_s_10k": round(walls[10_000]["cold_s"], 3),
+            "rebuild_s_10k": round(walls[10_000]["rebuild_s"], 3)}
+
+
 CELLS = [("register_100", bench_register_100),
          ("engine_crossover", bench_engine_crossover),
          ("deep_wgl_4n_2000", bench_deep_wgl),
@@ -1404,7 +1497,8 @@ CELLS = [("register_100", bench_register_100),
          ("campaign_amortization", bench_campaign_amortization),
          ("service_scaling", bench_service_scaling),
          ("guided_search", bench_guided_search),
-         ("mvcc_surfaces", bench_mvcc_surfaces)]
+         ("mvcc_surfaces", bench_mvcc_surfaces),
+         ("store_index", bench_store_index)]
 
 
 # ---------------------------------------------------------------------
@@ -1915,6 +2009,58 @@ def _dry_mvcc_surfaces():
             "pins": tripped}
 
 
+def _dry_store_index():
+    """Index structure at tiny size, no timing: a rebuilt index must
+    replay the exact rows a tree walk derives, survive the
+    row-count/fingerprint verify, match an incrementally-written index
+    row-for-row, and window /aggregate tables with clamped bounds."""
+    import os
+    import shutil
+    import tempfile
+    from jepsen_etcd_tpu import serve
+    from jepsen_etcd_tpu.runner import store_index
+
+    tmp = tempfile.mkdtemp(prefix="dry-idx-")
+    try:
+        _synth_store(tmp, 12)
+        walk = serve._run_rows(tmp)  # no index yet: the tree walk
+        store_index.rebuild(tmp)
+        fold = store_index.fold(tmp)
+        assert fold is not None, "rebuild produced no readable index"
+        indexed = store_index.serve_run_rows(fold)
+        assert indexed == walk, "index rows != walk rows"
+        v = store_index.verify(tmp)
+        assert v["ok"], v
+
+        # incremental writes land the same rows a full rebuild derives
+        inc = os.path.join(tmp, "inc-store")
+        _synth_store(inc, 3)
+        store_index.rebuild(inc)
+        _synth_store(inc, 3, start=3)
+        for i in range(3, 6):
+            store_index.record_run(
+                os.path.join(inc, f"synth-{i % 5}", f"{i:05d}"))
+        f_inc = store_index.fold(inc)
+        rows_inc = store_index.serve_run_rows(f_inc)
+        store_index.rebuild(inc)
+        rows_reb = store_index.serve_run_rows(store_index.fold(inc))
+        assert rows_inc == rows_reb, "incremental != rebuild rows"
+
+        # pagination bounds: interior window, and out-of-range clamps
+        page2 = serve.aggregate_html(tmp, page=2, per=5)
+        assert "rows 6–10 of 12" in page2, "page window off"
+        clamped = serve.aggregate_html(tmp, page=99, per=5)
+        assert "rows 11–12 of 12" in clamped, "page clamp off"
+        assert serve._page_window(12, "junk", "junk") == \
+            (0, 12, 1, 1, serve._DEF_PER), "bad query args must clamp"
+        return {"runs": 12, "rows": len(indexed),
+                "fingerprint": v["fingerprint"], "incremental": 3}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+        serve._AGG_CACHE.clear()
+        store_index._FOLDS.clear()
+
+
 DRY_CHECKS = {"register_100": _dry_register,
               "engine_crossover": _dry_register,
               "deep_wgl_4n_2000": _dry_register,
@@ -1936,6 +2082,7 @@ DRY_CHECKS = {"register_100": _dry_register,
               "service_scaling": _dry_service_scaling,
               "guided_search": _dry_guided_search,
               "mvcc_surfaces": _dry_mvcc_surfaces,
+              "store_index": _dry_store_index,
               "register_10k": _dry_register}
 
 
@@ -1953,7 +2100,12 @@ LINT_GATED = ("jepsen_etcd_tpu/ops/wgl.py",
               # and the surface checkers: a dict materialization there
               # IS the regression the cell exists to catch
               "jepsen_etcd_tpu/core/mvcc.py",
-              "jepsen_etcd_tpu/checkers/mvcc.py")
+              "jepsen_etcd_tpu/checkers/mvcc.py",
+              # the store_index cell times the fold/render path over
+              # index rows derived by these two: a determinism or
+              # registry slip there skews every dashboard they feed
+              "jepsen_etcd_tpu/runner/store.py",
+              "jepsen_etcd_tpu/runner/store_index.py")
 
 
 def _lint_gate() -> None:

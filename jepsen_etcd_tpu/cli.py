@@ -320,6 +320,30 @@ def build_parser() -> argparse.ArgumentParser:
                          "minimized repros")
     tl.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
+    tl.add_argument("--no-index", action="store_true", dest="no_index",
+                    help="bypass the store index and walk the tree "
+                         "(output is bit-identical either way)")
+    so = sub.add_parser("store",
+                        help="artifact-store maintenance: build/verify "
+                             "the sqlite run index, or compact old "
+                             "passing runs to summaries; never touches "
+                             "the jax backend")
+    so.add_argument("action", choices=("index", "compact"),
+                    help="index: verify (default) or --rebuild the "
+                         "run index; compact: demote old passing runs "
+                         "to index rows + summary files")
+    so.add_argument("--store", default="store",
+                    help="store base directory (default: store)")
+    so.add_argument("--rebuild", action="store_true",
+                    help="with `index`: backfill the index from the "
+                         "tree in one transaction (also recurses into "
+                         "guided sub-stores)")
+    so.add_argument("--keep", type=int, default=32,
+                    help="with `compact`: newest N runs spared "
+                         "regardless of verdict (default 32)")
+    so.add_argument("--dry-run", action="store_true", dest="dry_run",
+                    help="with `compact`: report what would be "
+                         "demoted without deleting anything")
     rp = sub.add_parser("replay",
                         help="re-execute a minimized repro "
                              "(shrink.json): regenerate the history "
@@ -448,6 +472,9 @@ def main(argv=None) -> int:
     if args.command == "tel":
         from .tel_cli import run as tel_run
         return tel_run(args)
+    if args.command == "store":
+        from .runner.store_index import cli_store
+        return cli_store(args)
     if args.command == "gateway":
         log = logging.getLogger("jepsen_etcd_tpu")
         if args.grpc:

@@ -55,6 +55,10 @@ DET_WALLCLOCK_ALLOW = (
     "runner/trace.py",
     "runner/test_runner.py",
     "runner/store.py",
+    "runner/store_index.py",     # index rows carry artifact mtimes
+                                 # (stat-based, never time.time) for
+                                 # dashboard ordering only — verdicts
+                                 # never read the index
     "runner/campaign.py",        # pool orchestration: wall-clock is
                                  # sweep accounting, never verdict
                                  # input (verdicts come from workers'

@@ -570,6 +570,16 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
                 logger.warning("live collector unavailable; campaign "
                                "continues without /live", exc_info=True)
                 collector = None
+            if collector is not None:
+                try:
+                    # register as a live-polling candidate so serve's
+                    # SSE tick stats this dir instead of listdir-ing
+                    # the whole store
+                    from .store_index import note_live
+                    note_live(cdir)
+                except Exception:
+                    logger.debug("live index registration failed",
+                                 exc_info=True)
         if service:
             from .checker_service import CheckerService
             # the service gets its own on-disk stream (service.jsonl in
@@ -742,6 +752,13 @@ def run_campaign(specs: list[dict], *, pool: int = 4,
     with open(os.path.join(cdir, "campaign.json"), "w") as f:
         json.dump(_scrub(summary), f, indent=2, default=repr)
     tel.close()
+    try:
+        # fold the campaign into the store index (and retire its live
+        # row) now that campaign.json and service.jsonl are complete
+        from .store_index import record_campaign
+        record_campaign(cdir)
+    except Exception:
+        logger.debug("campaign index write failed", exc_info=True)
     link_latest(cdir)
     logger.info(
         "campaign %s: %d runs, %d failures, %.1f s (dir %s)",

@@ -118,6 +118,9 @@ class GuidedScheduler:
         self.runs_observed = 0
         self.mutations = 0
         self.crossovers = 0
+        #: imported ancestors evicted after a full generation below
+        #: effective score 1 (ROADMAP #2 aging residual)
+        self.corpus_retired = 0
 
     # -- candidate generation ----------------------------------------
 
@@ -125,6 +128,7 @@ class GuidedScheduler:
         """Up to ``size`` opts dicts: pending stratified cells first,
         then mutants/crossovers of corpus ancestors."""
         self.wave += 1
+        self._retire_stale()
         out = []
         while self._pending and len(out) < size:
             out.append(self._pending.pop(0))
@@ -161,6 +165,29 @@ class GuidedScheduler:
             self.corpus.sort(
                 key=lambda c: (-self._eff_score(c), c["run"]))
             del self.corpus[self.corpus_cap:]
+
+    def _retire_stale(self) -> None:
+        """Retire imported ancestors whose effective score has sat
+        below 1 for a FULL generation. ``_pick`` already excludes them
+        from mutation draws, but under the cap they lingered in the
+        corpus (and its artifact) forever; one grace generation lets
+        an entry whose decay step lands mid-generation still be drawn
+        before it goes."""
+        kept = []
+        for c in self.corpus:
+            if not c.get("imported") or self._eff_score(c) >= 1.0:
+                c.pop("stale_since", None)
+                kept.append(c)
+                continue
+            since = c.get("stale_since")
+            if since is None:
+                c["stale_since"] = self.wave
+                kept.append(c)
+            elif self.wave - int(since) < 1:
+                kept.append(c)
+            else:
+                self.corpus_retired += 1
+        self.corpus[:] = kept
 
     def _pick(self) -> dict:
         # stale imported ancestors (effective score decayed below 1)
@@ -516,6 +543,7 @@ def run_guided(base_opts: dict, workloads: list, nemeses: list, *,
         tel.counter("guided.mutations", sched.mutations)
         tel.counter("guided.crossovers", sched.crossovers)
         tel.counter("guided.signatures", len(sched.seen_signatures))
+        tel.counter("guided.corpus_retired", sched.corpus_retired)
     finally:
         out = {
             "schema": 1, "kind": "guided", "name": name, "dir": gdir,
@@ -529,6 +557,7 @@ def run_guided(base_opts: dict, workloads: list, nemeses: list, *,
             "first_failure_run": first_failure,
             "corpus": sched.corpus,
             "corpus_imported": imported,
+            "corpus_retired": sched.corpus_retired,
             "corpus_in": corpus_in, "corpus_out": corpus_out,
             "minimized": minimized,
             "ledger": ledger,
@@ -537,6 +566,12 @@ def run_guided(base_opts: dict, workloads: list, nemeses: list, *,
         }
         with open(os.path.join(gdir, "guided.json"), "w") as f:
             json.dump(_scrub(out), f, indent=2, default=repr)
+        try:
+            # fold the finished search into its parent store's index
+            from .store_index import record_guided
+            record_guided(gdir)
+        except Exception:
+            pass
         if corpus_out:
             with open(corpus_out, "w") as f:
                 json.dump(_scrub(sched.export_corpus()), f, indent=2,

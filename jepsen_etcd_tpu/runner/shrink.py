@@ -52,8 +52,8 @@ def _checker_for(config: BatchConfig, checker_opts: dict):
 
 
 def _signature(results: dict) -> str:
-    from ..serve import _failure_signature
-    return _failure_signature(results)
+    from .store import failure_signature
+    return failure_signature(results)
 
 
 def checker_opts_from(opts: dict) -> dict:
@@ -190,6 +190,11 @@ def shrink_run(opts: dict, seed: int, *, store_dir: Optional[str] = None,
         with open(path, "w") as f:
             json.dump(art, f, indent=1, sort_keys=True)
         tel.counter("shrink.artifacts")
+        try:  # surface the artifact on the dashboard immediately
+            from .store_index import record_shrink
+            record_shrink(store_dir)
+        except Exception:
+            pass
     else:
         art["repro"] = "python -m jepsen_etcd_tpu replay <shrink.json>"
     return art

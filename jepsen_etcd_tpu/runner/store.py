@@ -19,6 +19,21 @@ logger = logging.getLogger("jepsen_etcd_tpu.store")
 
 _seq = itertools.count()
 
+
+def failure_signature(results: dict) -> str:
+    """Canonical dedupe key for failing runs: the sorted set of
+    ``checker=verdict`` entries that are not clean passes. THE single
+    implementation — the dashboard (serve.py re-exports it as
+    ``_failure_signature``), tel --coverage, shrink and the store
+    index all import it from here, so index rows store the signature
+    once and every reader agrees on it."""
+    sig = []
+    for k, v in results.items():
+        if isinstance(v, dict) and "valid?" in v and \
+                v.get("valid?") is not True:
+            sig.append(f"{k}={v.get('valid?')}")
+    return ", ".join(sorted(sig))
+
 #: total store size cap: once exceeded, oldest runs are deleted after
 #: each save (long test-all sweeps write GBs of artifacts and would
 #: otherwise fill the disk). 0 disables rotation.
@@ -71,6 +86,12 @@ def rotate_store(base: str, keep_dir: str = None,
         total -= size
         removed.append(rd)
     if removed:
+        try:
+            from .store_index import mark_deleted
+            mark_deleted(base, [os.path.relpath(rd, base)
+                                for rd in removed])
+        except Exception:
+            logger.debug("index tombstone failed", exc_info=True)
         # WARNING with the list: rotation is on by default (2 GiB cap)
         # and may remove runs of OTHER tests under the store base —
         # pre-existing artifacts a user cares about deserve a loud,
@@ -179,6 +200,15 @@ def save_run(store_dir: str, test: dict, history, results: dict,
         os.makedirs(nd, exist_ok=True)
         with open(os.path.join(nd, "etcd.log"), "w") as f:
             f.write("\n".join(lines))
+    # index the run the moment its artifacts are complete: readers
+    # (/aggregate, tel) fold the new row instead of re-walking the
+    # tree. Best-effort — an index failure must never fail the save.
+    try:
+        from .store_index import record_run
+        record_run(store_dir)
+    except Exception:
+        logger.debug("index write failed for %s", store_dir,
+                     exc_info=True)
     # keep long sweeps from filling the disk; never touches this run
     rotate_store(os.path.dirname(os.path.dirname(store_dir)),
                  keep_dir=store_dir)

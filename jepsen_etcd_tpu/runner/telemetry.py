@@ -257,6 +257,16 @@ REGISTRY = {
         "guided.mutations",       # mutants generated
         "guided.crossovers",      # crossover children generated
         "guided.corpus-imported",  # ancestors merged from --corpus-in
+        "guided.corpus_retired",  # imported ancestors evicted after a
+                                  # full generation below score 1
+        "store.index_rows",       # runner/store_index.py: rows written
+                                  # by a full `store index --rebuild`
+        "store.index_writes",     # incremental index rows written at
+                                  # save_run / campaign-fold time
+        "store.compacted",        # passing runs demoted to index rows
+                                  # + summaries by `store compact`
+        "store.compact_skipped_failures",  # compaction candidates left
+                                  # untouched because they failed
         "shrink.runs",            # runner/shrink.py: shrinks attempted
         "shrink.candidates",      # candidate schedules re-executed
         "shrink.rounds",          # ddmin rounds run

@@ -43,20 +43,6 @@ _PHASES = (("setup", "#9ab8d8"), ("generate", "#8fc98f"),
            ("check", "#e0a848"), ("save", "#b8a0d0"))
 
 
-def _overlap_ratio(phases: dict, counters: dict):
-    """End-to-end-over-generation ratio for streamed runs: how close
-    checking came to free. (generate + stream-finalize + check) /
-    generate — 1.0 means verification added no wall time beyond
-    generation. None for runs that never streamed a chunk."""
-    if not counters.get("stream.chunks"):
-        return None
-    gen = phases.get("generate")
-    if not isinstance(gen, (int, float)) or gen <= 0:
-        return None
-    extra = sum(phases.get(k) or 0 for k in ("stream-finalize", "check"))
-    return (gen + extra) / gen
-
-
 def _badge(v) -> str:
     cls = {"True": "ok", True: "ok", False: "bad", "False": "bad"}.get(
         v, "unk")
@@ -71,39 +57,26 @@ def _load_json(path: str):
         return None
 
 
-def _failure_signature(results: dict) -> str:
-    """Dedupe key for failing runs: the sorted set of
-    ``checker=verdict`` entries that are not clean passes."""
-    sig = []
-    for k, v in results.items():
-        if isinstance(v, dict) and "valid?" in v and \
-                v.get("valid?") is not True:
-            sig.append(f"{k}={v.get('valid?')}")
-    return ", ".join(sorted(sig))
-
-
-#: MVCC consistency-surface checker keys (checkers/mvcc.py) surfaced
-#: as their own /aggregate column: surface name -> short label
-_SURFACES = {"staleness": "stale", "ranges": "ranges",
-             "lease": "lease", "watch-mvcc": "watch"}
-
-
-def _consistency_surface(results: dict) -> dict:
-    """``{label: {"valid": verdict, "violations": n}}`` for every MVCC
-    surface checker that ran in this run's composed workload result."""
-    wlr = results.get("workload")
-    out = {}
-    if isinstance(wlr, dict):
-        for key, label in _SURFACES.items():
-            sub = wlr.get(key)
-            if isinstance(sub, dict) and "valid?" in sub:
-                out[label] = {
-                    "valid": sub.get("valid?"),
-                    "violations": sub.get("violation-count", 0)}
-    return out
+# Row derivation lives in runner/store_index.py now: the index writer
+# and these walk fallbacks call the SAME builders, so index-backed
+# pages replay bit-identically to a fresh tree walk. The old private
+# names stay importable (tel_cli and shrink import _failure_signature;
+# the canonical implementation is runner/store.failure_signature).
+from .runner.store import failure_signature as _failure_signature  # noqa: E402
+from .runner.store_index import (  # noqa: E402
+    SURFACES as _SURFACES,
+    chip_util as _chip_util,
+    consistency_surface as _consistency_surface,
+    host_ledger as _host_ledger,
+    overlap_ratio as _overlap_ratio,
+)
 
 
 def _run_rows(store_base: str) -> list[dict]:
+    from .runner import store_index
+    fold = store_index.fold(store_base)
+    if fold is not None:
+        return store_index.serve_run_rows(fold)
     from .forensics import all_runs
     rows = []
     for rdir in all_runs(store_base):
@@ -114,27 +87,7 @@ def _run_rows(store_base: str) -> list[dict]:
             mtime = os.path.getmtime(rdir)
         except OSError:
             mtime = 0
-        ops = (results.get("stats") or {}).get("count")
-        tel = results.get("telemetry") or {}
-        nem = test.get("nemesis_spec") or []
-        if isinstance(nem, (list, tuple)):
-            nem = ",".join(str(n) for n in nem)
-        rows.append({"dir": rel, "mtime": mtime,
-                     "valid?": results.get("valid?", "?"),
-                     "name": test.get("name", rel.split(os.sep)[0]),
-                     "workload": test.get("workload", "?"),
-                     "nemesis": nem or "none",
-                     "db": test.get("db_mode") or "sim",
-                     "time_limit": test.get("time_limit"),
-                     "ops": ops,
-                     "phases": tel.get("phases") or {},
-                     "gen_rate": (tel.get("counters") or {})
-                     .get("generate.ops_per_s"),
-                     "overlap": _overlap_ratio(
-                         tel.get("phases") or {},
-                         tel.get("counters") or {}),
-                     "consistency": _consistency_surface(results),
-                     "signature": _failure_signature(results)})
+        rows.append(store_index.run_row(rel, results, test, mtime))
     rows.sort(key=lambda r: r["mtime"], reverse=True)
     return rows
 
@@ -146,6 +99,10 @@ def _campaign_rows(store_base: str) -> list[dict]:
     history.jsonl, so the run index never lists them — this is their
     only dashboard surface.) Sorted oldest-first: the table reads as a
     trend over successive campaigns."""
+    from .runner import store_index
+    fold = store_index.fold(store_base)
+    if fold is not None:
+        return store_index.serve_campaign_rows(fold)
     rows = []
     try:
         names = sorted(os.listdir(store_base))
@@ -166,67 +123,12 @@ def _campaign_rows(store_base: str) -> list[dict]:
             summary = _load_json(cpath)
             if not isinstance(summary, dict) or "runs" not in summary:
                 continue
-            runs = [r for r in (summary.get("runs") or [])
-                    if isinstance(r, dict)]
-            done = [r for r in runs if r.get("status") == "done"]
-            rates = [r["gen_ops_per_s"] for r in done
-                     if isinstance(r.get("gen_ops_per_s"),
-                                   (int, float))]
-            sctr = ((summary.get("service") or {}).get("counters")
-                    or {})
-            svc_disp = sum(int(sctr.get(k, 0) or 0)
-                           for k in ("wgl.dispatches",
-                                     "mxu.dispatches"))
-            local_disp = sum(int(r.get("dispatches") or 0)
-                             for r in done)
             try:
                 mtime = os.path.getmtime(cpath)
             except OSError:
                 mtime = 0
-            # lossy-link diagnosis triple, summed over the rows' net.*
-            # counters (runner/campaign._row_net)
-            net = {"dropped_chunks": 0, "accept_errors": 0,
-                   "delayed_bytes": 0}
-            for r in done:
-                for k in net:
-                    try:
-                        net[k] += int((r.get("net") or {}).get(k) or 0)
-                    except (TypeError, ValueError):
-                        pass
-            rows.append({
-                "dir": os.path.relpath(os.path.dirname(cpath),
-                                       store_base),
-                "mtime": mtime, "name": summary.get("name", name),
-                "count": summary.get("count"),
-                "pool": summary.get("pool"),
-                "valid?": summary.get("valid?", "?"),
-                "wall_s": summary.get("wall_s"),
-                "gen_rate": (sum(rates) / len(rates)) if rates
-                else None,
-                # batched lockstep generation (simbatch epoch-v2
-                # routing): aggregate events/s across each cell's seed
-                # batch, None for epoch-v1-only campaigns
-                "genbatch": summary.get("genbatch") or None,
-                "check_s": sum(r.get("check_s") or 0 for r in done),
-                "dispatches": svc_disp + local_disp,
-                "submitted": sctr.get("service.submitted"),
-                "group_ticks": sctr.get("service.group_ticks"),
-                "occupancy": sctr.get("service.batch_occupancy"),
-                "chips": _chip_util(sctr),
-                "fallbacks": sum(int(r.get("service_fallbacks") or 0)
-                                 for r in done),
-                # multi-host campaigns: per-host run/shipped fold
-                # joined against the service's per-host submitted
-                # series (the cross-host ledger, runner/host_agent.py)
-                "hosts": _host_ledger(summary, sctr),
-                "agent_requeues": int(
-                    summary.get("agent_requeues") or 0),
-                # campaign-wide merged-histogram percentiles
-                # ({label: [p50, p95, p99]}, seconds)
-                "p": summary.get("p") if isinstance(summary.get("p"),
-                                                    dict) else {},
-                "net": net,
-            })
+            rows.append(store_index.campaign_row(
+                os.path.join(name, rid), summary, mtime))
     rows.sort(key=lambda r: r["mtime"])
     return rows
 
@@ -237,6 +139,10 @@ def _guided_rows(store_base: str) -> list[dict]:
     runner/guided.run_guided. Same two-level walk as
     ``_campaign_rows`` (guided dirs carry no history.jsonl either).
     Sorted oldest-first."""
+    from .runner import store_index
+    fold = store_index.fold(store_base)
+    if fold is not None:
+        return store_index.serve_guided_rows(fold)
     rows = []
     try:
         names = sorted(os.listdir(store_base))
@@ -262,20 +168,8 @@ def _guided_rows(store_base: str) -> list[dict]:
                 mtime = os.path.getmtime(gpath)
             except OSError:
                 mtime = 0
-            rows.append({
-                "dir": os.path.relpath(os.path.dirname(gpath),
-                                       store_base),
-                "mtime": mtime,
-                "name": summary.get("name", name),
-                "budget": summary.get("budget"),
-                "runs": summary.get("runs"),
-                "generations": summary.get("generations"),
-                "signatures": summary.get("signatures") or {},
-                "first_failure_run": summary.get("first_failure_run"),
-                "corpus": len(summary.get("corpus") or []),
-                "minimized": summary.get("minimized") or [],
-                "wall_s": summary.get("wall_s"),
-            })
+            rows.append(store_index.guided_row(
+                os.path.join(name, rid), summary, mtime))
     rows.sort(key=lambda r: r["mtime"])
     return rows
 
@@ -286,6 +180,10 @@ def _shrink_rows(store_base: str) -> list[dict]:
     not forensics.all_runs — guided campaigns nest their runs one
     level deeper (``<store>/<name>/<id>/gen<N>/<run>``) than the
     two-level run index. Newest first."""
+    from .runner import store_index
+    fold = store_index.fold(store_base)
+    if fold is not None:
+        return store_index.serve_shrink_rows(fold, store_base)
     rows = []
     for root, dirs, files in os.walk(store_base, followlinks=False):
         dirs[:] = [d for d in dirs
@@ -300,62 +198,10 @@ def _shrink_rows(store_base: str) -> list[dict]:
             mtime = os.path.getmtime(os.path.join(rdir, "shrink.json"))
         except OSError:
             mtime = 0
-        rows.append({
-            "dir": os.path.relpath(rdir, store_base),
-            "mtime": mtime,
-            "workload": art.get("workload"),
-            "signature": art.get("signature"),
-            "original_windows": art.get("original_windows"),
-            "windows": art.get("windows"),
-            "nemesis_ops": art.get("nemesis_ops"),
-            "rounds": art.get("rounds"),
-            "executions": art.get("executions"),
-            "repro": art.get("repro"),
-        })
+        rows.append(store_index.shrink_row(
+            os.path.relpath(rdir, store_base), art, mtime))
     rows.sort(key=lambda r: r["mtime"], reverse=True)
     return rows
-
-
-def _host_ledger(summary: dict, sctr: dict) -> dict | None:
-    """Per-host attribution for a multi-host campaign: the rows' fold
-    (runs + shipped per host, producer side) joined with the service's
-    ``service.host_submitted.<host>`` counters (consumer side). The
-    two shipped numbers must agree — that is the cross-host
-    shipped==submitted ledger. None for single-host campaigns."""
-    hosts = summary.get("hosts")
-    if not isinstance(hosts, dict) or not hosts:
-        return None
-    out = {}
-    for h, st in sorted(hosts.items()):
-        st = dict(st) if isinstance(st, dict) else {}
-        st["submitted"] = sctr.get("service.host_submitted." + h)
-        out[h] = st
-    return out
-
-
-def _chip_util(sctr: dict) -> dict | None:
-    """Per-chip utilization summary from a campaign's folded service
-    counters (the sharded dispatcher's ledger): group dispatches and
-    busy wall per device, the max/min dispatch balance ratio, and peak
-    per-tick device occupancy. None for single-device/legacy
-    campaigns, which recorded no per-device dispatch series."""
-    pfx_d = "service.device_dispatches."
-    pfx_b = "service.device_busy_s."
-    disp = {k[len(pfx_d):]: int(v or 0) for k, v in sctr.items()
-            if k.startswith(pfx_d)}
-    if not disp:
-        return None
-    busy = {k[len(pfx_b):]: float(v or 0.0) for k, v in sctr.items()
-            if k.startswith(pfx_b)}
-    lo = min(disp.values())
-    return {
-        "devices": len(disp),
-        "dispatches": disp,
-        "busy_s": busy,
-        "balance": (max(disp.values()) / lo) if lo else None,
-        "occupancy": sctr.get("service.device_occupancy"),
-        "sharded_ticks": sctr.get("service.sharded_ticks"),
-    }
 
 
 def _fmt_s(v) -> str:
@@ -430,10 +276,79 @@ def index_html(store_base: str) -> str:
             + "".join(rows) + "</table>")
 
 
-def aggregate_html(store_base: str) -> str:
+#: /aggregate pagination: the pass/fail matrix always aggregates ALL
+#: runs, but the per-run phase table and the failure tables window at
+#: ``per`` rows (?page=/?per=) so a 10k-run store renders flat
+_DEF_PER = 200
+_MAX_PER = 1000
+
+#: per-process render cache for index-backed /aggregate pages:
+#: (base, page, per) -> (fold generation vector, html). Unindexed
+#: stores are never cached — there is no cheap invalidation signal.
+_AGG_CACHE: dict = {}
+
+
+def _agg_gens(store_base: str):
+    """Generation vector covering every fold /aggregate reads: the
+    base index plus each guided sub-index feeding the shrink table.
+    Any committed index write bumps a component. None when the store
+    is unindexed."""
+    from .runner import store_index
+    fold = store_index.fold(store_base)
+    if fold is None:
+        return None
+    gens = [fold.gen]
+    for d in store_index.kind_dirs(fold, "guided"):
+        sub = store_index.fold(os.path.join(store_base, d))
+        gens.append(-1 if sub is None else sub.gen)
+    return tuple(gens)
+
+
+def _page_window(total: int, page, per):
+    """Clamped (lo, hi, page, pages, per) for one table's window."""
+    try:
+        per = int(per) if per else _DEF_PER
+    except (TypeError, ValueError):
+        per = _DEF_PER
+    per = max(1, min(per, _MAX_PER))
+    try:
+        page = int(page) if page else 1
+    except (TypeError, ValueError):
+        page = 1
+    pages = max(1, -(-total // per))
+    page = max(1, min(page, pages))
+    lo = (page - 1) * per
+    return lo, min(lo + per, total), page, pages, per
+
+
+def _pager(lo: int, hi: int, page: int, pages: int, per: int,
+           total: int) -> str:
+    if pages <= 1:
+        return ""
+    bits = [f"<p class='dim'>rows {lo + 1}–{hi} of {total} · "]
+    if page > 1:
+        bits.append(f'<a href="/aggregate?page={page - 1}'
+                    f'&amp;per={per}">&larr; prev</a> · ')
+    bits.append(f"page {page}/{pages}")
+    if page < pages:
+        bits.append(f' · <a href="/aggregate?page={page + 1}'
+                    f'&amp;per={per}">next &rarr;</a>')
+    bits.append("</p>")
+    return "".join(bits)
+
+
+def aggregate_html(store_base: str, page=1, per=None) -> str:
     """The cross-run dashboard: pass/fail matrix over workload ×
     (nemesis, db), per-run telemetry phase bars, and failure dedupe by
-    checker verdict signature."""
+    checker verdict signature. The per-run and failure tables window
+    at ``per`` rows (?page=/?per=); index-backed renders are cached
+    per (page, per) until the index generation moves."""
+    gens = _agg_gens(store_base)
+    cache_key = (os.path.abspath(store_base), page, per)
+    if gens is not None:
+        hit = _AGG_CACHE.get(cache_key)
+        if hit is not None and hit[0] == gens:
+            return hit[1]
     rows = _run_rows(store_base)
     out = [f"<!doctype html><title>aggregate — jepsen_etcd_tpu</title>",
            f"<style>{_CSS}</style>",
@@ -480,11 +395,13 @@ def aggregate_html(store_base: str) -> str:
     out.append("</table>")
 
     # -- per-run phase breakdown bars ----------------------------------------
-    out.append("<h2>Phase breakdown (wall time per run)</h2>"
-               "<table><tr><th>run</th><th>valid?</th>"
+    lo, hi, pg, pages, per_n = _page_window(len(rows), page, per)
+    out.append("<h2>Phase breakdown (wall time per run)</h2>")
+    out.append(_pager(lo, hi, pg, pages, per_n, len(rows)))
+    out.append("<table><tr><th>run</th><th>valid?</th>"
                "<th>consistency</th>"
                "<th>gen ops/s</th><th>e2e/gen</th><th>phases</th></tr>")
-    for r in rows:
+    for r in rows[lo:hi]:
         rate = r.get("gen_rate")
         rate_td = (f"<td>{rate:,.0f}</td>"
                    if isinstance(rate, (int, float))
@@ -702,10 +619,13 @@ def aggregate_html(store_base: str) -> str:
         groups: dict = {}
         for r in verdicts:
             groups.setdefault(r["signature"], []).append(r)
+        grouped = sorted(groups.items(), key=lambda kv: -len(kv[1]))
+        glo, ghi, gpg, gpages, gper = _page_window(len(grouped),
+                                                   page, per)
+        out.append(_pager(glo, ghi, gpg, gpages, gper, len(grouped)))
         out.append("<table><tr><th>verdict signature</th>"
                    "<th>runs</th><th>dirs</th></tr>")
-        for sig, rs in sorted(groups.items(),
-                              key=lambda kv: -len(kv[1])):
+        for sig, rs in grouped[glo:ghi]:
             links = " ".join(
                 f'<a href="/{quote(r["dir"])}/">'
                 f'{html.escape(r["dir"])}</a>' for r in rs[:12])
@@ -713,19 +633,27 @@ def aggregate_html(store_base: str) -> str:
                        f"<td>{len(rs)}</td><td>{links}</td></tr>")
         out.append("</table>")
     if infra:
+        ilo, ihi, ipg, ipages, iper = _page_window(len(infra),
+                                                   page, per)
         out.append(
             "<h2>Infrastructure / harness errors</h2>"
             "<p class='dim'>failing runs with no checker verdict — "
             "harness noise, not consistency results; excluded from "
-            "the verdict dedupe above</p>"
-            "<table><tr><th>run</th><th>valid?</th></tr>")
-        for r in infra[:24]:
+            "the verdict dedupe above</p>")
+        out.append(_pager(ilo, ihi, ipg, ipages, iper, len(infra)))
+        out.append("<table><tr><th>run</th><th>valid?</th></tr>")
+        for r in infra[ilo:ihi]:
             out.append(
                 f'<tr><td><a href="/{quote(r["dir"])}/">'
                 f'{html.escape(r["dir"])}</a></td>'
                 f"<td>{_badge(r['valid?'])}</td></tr>")
         out.append("</table>")
-    return "".join(out)
+    body = "".join(out)
+    if gens is not None:
+        _AGG_CACHE[cache_key] = (gens, body)
+        if len(_AGG_CACHE) > 64:  # a scraper walking ?page= must not
+            _AGG_CACHE.clear()    # grow the cache unboundedly
+    return body
 
 
 #: test.json keys shown in the run page's parameter table, in order
@@ -923,7 +851,30 @@ LIVE_MAX_EVENTS = 3600
 def _live_snapshot(store_base: str):
     """``(snapshot, mtime, rel_dir)`` of the NEWEST ``live.json``
     under the store (the running — or most recent — campaign's
-    collector output), or None when no campaign ever ran live."""
+    collector output), or None when no campaign ever ran live.
+
+    Indexed stores stat only the registered candidates (campaigns
+    note themselves via store_index.note_live the moment their
+    LiveCollector starts), so each SSE tick is O(campaigns) instead
+    of a store-wide two-level listdir."""
+    from .runner import store_index
+    cands = store_index.live_candidates(store_base)
+    if cands is not None:
+        best = None
+        for rel in cands:
+            p = os.path.join(store_base, rel, "live.json")
+            try:
+                mtime = os.path.getmtime(p)
+            except OSError:
+                continue
+            if best is None or mtime > best[1]:
+                best = (p, mtime, rel)
+        if best is None:
+            return None
+        snap = _load_json(best[0])
+        if not isinstance(snap, dict):
+            return None
+        return snap, best[1], best[2]
     best = None
     try:
         names = os.listdir(store_base)
@@ -1091,7 +1042,11 @@ class StoreHandler(SimpleHTTPRequestHandler):
         if path in ("/", "/index.html"):
             return self._html(index_html(self.store_base))
         if path in ("/aggregate", "/aggregate/"):
-            return self._html(aggregate_html(self.store_base))
+            aq = parse_qs(query, keep_blank_values=True)
+            return self._html(aggregate_html(
+                self.store_base,
+                page=(aq.get("page") or [1])[0],
+                per=(aq.get("per") or [None])[0]))
         if path in ("/live", "/live/"):
             if "sse" in parse_qs(query, keep_blank_values=True):
                 return self._sse_live()

@@ -158,11 +158,45 @@ def cmd_spans(paths: list, as_json: bool) -> int:
     return 0
 
 
-def cmd_diff(paths: list, as_json: bool) -> int:
+def _merge_profiles(profs: list) -> dict:
+    """Fold per-file profiles into one. Bit-identical to a single
+    scan() over the same files for everything --diff prints: counts
+    are ints and percentiles derive from bucket counts + exact
+    min/max, none of which depend on float-summation order."""
+    out: dict = {"files": 0, "records": 0, "skipped": 0,
+                 "spans": {}, "hists": {}, "counters": {},
+                 "traces": set()}
+    for p in profs:
+        out["files"] += p["files"]
+        out["records"] += p["records"]
+        out["skipped"] += p["skipped"]
+        for n, h in p["spans"].items():
+            out["spans"].setdefault(n, Hist()).merge(h)
+        for n, h in p["hists"].items():
+            out["hists"].setdefault(n, Hist()).merge(h)
+        for n, v in p["counters"].items():
+            out["counters"][n] = out["counters"].get(n, 0) + v
+        out["traces"] |= p["traces"]
+    return out
+
+
+def _scan_cached(files: list, use_index: bool) -> dict:
+    """scan(), but each file's profile is served from its store
+    index's tel_cache when fresh (runner/store_index.tel_profile) —
+    repeat diffs against a hot store re-read nothing."""
+    if not use_index:
+        return scan(files)
+    from .runner import store_index
+    return _merge_profiles(
+        [store_index.tel_profile(f, scan) for f in files])
+
+
+def cmd_diff(paths: list, as_json: bool,
+             use_index: bool = True) -> int:
     if len(paths) != 2:
         raise SystemExit("tel --diff takes exactly two inputs")
-    pa = scan(_resolve(paths[0]))
-    pb = scan(_resolve(paths[1]))
+    pa = _scan_cached(_resolve(paths[0]), use_index)
+    pb = _scan_cached(_resolve(paths[1]), use_index)
     names = sorted(set(pa["spans"]) | set(pb["spans"]))
     delta = []
     for n in names:
@@ -215,7 +249,7 @@ def _load_campaign(path: str) -> tuple:
     return path, summary
 
 
-def ledger(path: str) -> dict:
+def ledger(path: str, use_index: bool = True) -> dict:
     """Verify the campaign's cross-process accounting. Three checks:
     shipped-pack conservation, queue-wait attribution, and the
     trace join between runner rows and service tick spans."""
@@ -243,13 +277,23 @@ def ledger(path: str) -> dict:
 
     svc_log = os.path.join(cdir, "service.jsonl")
     if os.path.isfile(svc_log):
-        recs, skipped = load_jsonl(svc_log)
-        ticked = set()
-        for rec in recs:
-            if rec.get("kind") == "span" and \
-                    rec.get("name") == "service.tick":
-                ticked.update((rec.get("attrs") or {})
-                              .get("runs") or ())
+        # the index row captured the tick-span trace join at campaign
+        # fold time (service.jsonl is complete then); it is used only
+        # while the file's fingerprint still matches
+        cached = None
+        if use_index:
+            from .runner import store_index
+            cached = store_index.ledger_ticks(cdir)
+        if cached is not None:
+            ticked, skipped = cached
+        else:
+            recs, skipped = load_jsonl(svc_log)
+            ticked = set()
+            for rec in recs:
+                if rec.get("kind") == "span" and \
+                        rec.get("name") == "service.tick":
+                    ticked.update((rec.get("attrs") or {})
+                                  .get("runs") or ())
         shippers = {r.get("trace") for r in done
                     if int(r.get("service_shipped") or 0) > 0
                     and r.get("trace") is not None}
@@ -272,8 +316,9 @@ def ledger(path: str) -> dict:
             "ok": all(c["ok"] is not False for c in checks)}
 
 
-def cmd_ledger(paths: list, as_json: bool) -> int:
-    out = ledger(paths[0])
+def cmd_ledger(paths: list, as_json: bool,
+               use_index: bool = True) -> int:
+    out = ledger(paths[0], use_index=use_index)
     if as_json:
         print(json.dumps(out, indent=2, sort_keys=True))
         return 0 if out["ok"] else 1
@@ -311,10 +356,24 @@ def _coverage_dirs(path: str) -> list:
     return sorted(out)
 
 
-def coverage(path: str) -> dict:
+def _read_vector(rdir: str):
+    """One run's coverage vector straight from its results.json;
+    None when unreadable (the walk skips those)."""
+    from .runner.store_index import coverage_fields
+    try:
+        with open(os.path.join(rdir, "results.json")) as fh:
+            results = json.load(fh)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+    return coverage_fields(results)
+
+
+def coverage(path: str, use_index: bool = True) -> dict:
     """The guided-campaign feature vector: how hard the checker had
     to work (frontier/rungs/spills) and what verdicts the fleet
-    produced (failure-signature histogram).
+    produced (failure-signature histogram). Vector derivation lives
+    in runner/store_index.coverage_fields — shared with the index
+    writer, so the index path below is bit-identical to the walk.
 
     A multi-host campaign's rows are tolerated, not required, to have
     artifacts on this machine: error rows (agent deaths past the
@@ -322,44 +381,31 @@ def coverage(path: str) -> dict:
     or inline-stranded run may lack ``telemetry.jsonl``/``results.json``
     — those fold into ``aggregate.skipped`` instead of erroring, and
     the rows' per-host column folds into ``aggregate.hosts``."""
-    from .serve import _failure_signature
+    from .runner import store_index
     rows_meta = None
-    if os.path.isfile(os.path.join(path, "campaign.json")) or \
-            path.endswith("campaign.json"):
+    is_campaign = os.path.isfile(
+        os.path.join(path, "campaign.json")) or \
+        path.endswith("campaign.json")
+    if is_campaign:
         _, summary = _load_campaign(path)
         rows_meta = [r for r in (summary.get("runs") or [])
                      if isinstance(r, dict)]
     runs = []
-    for rdir in _coverage_dirs(path):
-        try:
-            with open(os.path.join(rdir, "results.json")) as fh:
-                results = json.load(fh)
-        except (OSError, json.JSONDecodeError, ValueError):
-            continue
-        if not isinstance(results, dict):
-            continue
-        tel_sum = results.get("telemetry") or {}
-        ctr = tel_sum.get("counters") or {}
-        # per-rung dispatch shape: the wgl.rung_waves histogram puts
-        # each ladder rung in its own log2 bucket, so
-        # {bucket: dispatches} IS the search-depth distribution —
-        # guided novelty scores newly-occupied buckets (+1 each)
-        wave_hist = {
-            int(b): int(c)
-            for b, c in (((tel_sum.get("hists") or {})
-                          .get("wgl.rung_waves") or {})
-                         .get("buckets") or {}).items()}
-        runs.append({"dir": rdir,
-                     "valid": results.get("valid?"),
-                     "frontier": int(ctr.get("wgl.max-frontier", 0)),
-                     "rungs": int(ctr.get("wgl.rungs", 0)),
-                     "spills": int(ctr.get("wgl.host-spill", 0)),
-                     # deepest BFS wave ladder reached (wgl.waves is a
-                     # mode=max counter): a depth dimension the width
-                     # features above can't see
-                     "waves": int(ctr.get("wgl.waves", 0)),
-                     "wave_hist": wave_hist,
-                     "signature": _failure_signature(results)})
+    if not is_campaign and use_index and \
+            not os.path.isfile(os.path.join(path, "results.json")):
+        # store-base operand: replay the index (recursing into guided
+        # sub-indexes) instead of walking the tree
+        pairs = store_index.coverage_run_vectors(path)
+        if pairs is not None:
+            runs = [dict(dir=d, **vec) for d, vec in pairs]
+    if not runs:
+        for rdir in _coverage_dirs(path):
+            vec = store_index.run_vector(rdir) if use_index else None
+            if vec is None:
+                vec = _read_vector(rdir)
+            if vec is None:
+                continue
+            runs.append(dict(dir=rdir, **vec))
     sigs = Counter(r["signature"] for r in runs if r["signature"])
     buckets: Counter = Counter()
     for r in runs:
@@ -391,8 +437,9 @@ def coverage(path: str) -> dict:
     return {"runs": runs, "aggregate": agg}
 
 
-def cmd_coverage(paths: list, as_json: bool) -> int:
-    out = coverage(paths[0])
+def cmd_coverage(paths: list, as_json: bool,
+                 use_index: bool = True) -> int:
+    out = coverage(paths[0], use_index=use_index)
     if as_json:
         print(json.dumps(out, indent=2, sort_keys=True))
         return 0
@@ -419,14 +466,20 @@ def cmd_coverage(paths: list, as_json: bool) -> int:
     return 0
 
 
-def _find_guided(path: str) -> str:
+def _find_guided(path: str, use_index: bool = True) -> str:
     """Resolve a --corpus operand to a guided.json: the file itself, a
-    guided dir containing one, or a store base (newest guided run)."""
+    guided dir containing one, or a store base (newest guided run,
+    answered by the store index when one exists)."""
     if os.path.isfile(path) and path.endswith("guided.json"):
         return path
     direct = os.path.join(path, "guided.json")
     if os.path.isfile(direct):
         return direct
+    if use_index:
+        from .runner import store_index
+        got = store_index.newest_guided(path)
+        if got is not None:
+            return got[1]
     cands = []
     for root, dirs, files in os.walk(path, followlinks=False):
         dirs[:] = [d for d in dirs
@@ -440,9 +493,9 @@ def _find_guided(path: str) -> str:
     return max(cands)[1]
 
 
-def corpus(path: str) -> dict:
+def corpus(path: str, use_index: bool = True) -> dict:
     """A guided campaign's search summary (guided.json)."""
-    gpath = _find_guided(path)
+    gpath = _find_guided(path, use_index=use_index)
     try:
         with open(gpath) as fh:
             out = json.load(fh)
@@ -453,8 +506,9 @@ def corpus(path: str) -> dict:
     return out
 
 
-def cmd_corpus(paths: list, as_json: bool) -> int:
-    out = corpus(paths[0])
+def cmd_corpus(paths: list, as_json: bool,
+               use_index: bool = True) -> int:
+    out = corpus(paths[0], use_index=use_index)
     if as_json:
         print(json.dumps(out, indent=2, sort_keys=True))
         return 0
@@ -487,15 +541,20 @@ def cmd_corpus(paths: list, as_json: bool) -> int:
 def run(args) -> int:
     """Entry point for the ``tel`` subcommand (cli.main dispatches
     here before any jax import)."""
+    use_index = not getattr(args, "no_index", False)
     try:
         if args.ledger:
-            return cmd_ledger(args.paths, args.as_json)
+            return cmd_ledger(args.paths, args.as_json,
+                              use_index=use_index)
         if getattr(args, "corpus", False):
-            return cmd_corpus(args.paths, args.as_json)
+            return cmd_corpus(args.paths, args.as_json,
+                              use_index=use_index)
         if args.coverage:
-            return cmd_coverage(args.paths, args.as_json)
+            return cmd_coverage(args.paths, args.as_json,
+                                use_index=use_index)
         if args.diff:
-            return cmd_diff(args.paths, args.as_json)
+            return cmd_diff(args.paths, args.as_json,
+                            use_index=use_index)
         return cmd_spans(args.paths, args.as_json)
     except BrokenPipeError:
         # `tel ... | head` closing stdout early is normal usage

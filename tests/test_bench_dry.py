@@ -163,6 +163,19 @@ def test_dry_guided_search_cell(dry_all):
     assert cell["replay_identical"] is True
 
 
+def test_dry_store_index_cell(dry_all):
+    """Tier-1 guard on the indexed-store cell's structure: a rebuilt
+    index replays the walk's rows exactly, survives the fingerprint
+    verify, matches incremental writes row-for-row, and the /aggregate
+    pager clamps — the 100-vs-10k latency ratio is only measured by
+    the real bench run, never here."""
+    cell = dry_all["store_index"]
+    assert cell["ok"] is True and cell["check"] == "_dry_store_index"
+    assert cell["runs"] == 12 and cell["rows"] == 12
+    assert cell["fingerprint"]["tree"] == cell["fingerprint"]["index"]
+    assert cell["incremental"] == 3
+
+
 def test_dry_rejects_unknown_cell():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     out = subprocess.run(

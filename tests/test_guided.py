@@ -164,6 +164,48 @@ def test_imported_ancestors_age_out_of_mutation_draws():
     assert s._pick() is imp, "all-stale corpus must not starve"
 
 
+def test_stale_imports_retire_after_one_full_generation():
+    """The aging residual (ISSUE 20 satellite): an imported ancestor
+    whose effective score sits below 1 gets ONE grace generation (its
+    decay step may land mid-wave) and is then evicted from the corpus
+    entirely, counted as ``corpus_retired``; natives never retire."""
+    from jepsen_etcd_tpu.runner.guided import IMPORT_HALF_LIFE_GENS
+
+    s = GuidedScheduler(BASE, ["register"], CELLS, seed0=0,
+                        master_seed=5)
+    imp = _corpus_entry(1, 1.5, imported=True, born=0)
+    nat = _corpus_entry(2, 0.5)  # low score, but native: immune
+    s.corpus[:] = [imp, nat]
+    for _ in range(IMPORT_HALF_LIFE_GENS):
+        s.next_generation(1)
+    # the decay step landed THIS wave (eff 0.75): marked, still drawn
+    assert imp in s.corpus and imp["stale_since"] == s.wave
+    assert s.corpus_retired == 0
+    s.next_generation(1)
+    assert imp not in s.corpus, "stale import must retire"
+    assert s.corpus_retired == 1
+    assert nat in s.corpus and "stale_since" not in nat
+
+
+def test_recovered_imports_clear_their_stale_marker():
+    """An import marked stale whose effective score recovers (e.g. a
+    mutant descendant re-earns it score) sheds the marker instead of
+    retiring on the next wave."""
+    from jepsen_etcd_tpu.runner.guided import IMPORT_HALF_LIFE_GENS
+
+    s = GuidedScheduler(BASE, ["register"], CELLS, seed0=0,
+                        master_seed=5)
+    imp = _corpus_entry(1, 1.5, imported=True, born=0)
+    s.corpus[:] = [imp]
+    for _ in range(IMPORT_HALF_LIFE_GENS):
+        s.next_generation(1)
+    assert imp["stale_since"] == s.wave
+    imp["score"] = 8.0  # recovers: eff back over 1
+    s.next_generation(1)
+    assert imp in s.corpus and "stale_since" not in imp
+    assert s.corpus_retired == 0
+
+
 def test_eviction_prefers_live_natives_over_stale_imports():
     """The cap sorts by effective (decayed) score: a once-dominant
     import with the highest RAW score is evicted once fresher native

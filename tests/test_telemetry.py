@@ -184,7 +184,12 @@ def test_run_writes_telemetry_and_reconciles(tmp_path):
     assert tel["counters"].get("engine.cpu-oracle", 0) >= 1
     file_counters = {r["name"]: r["value"] for r in recs
                      if r["kind"] == "counter"}
-    assert file_counters == tel["counters"]
+    # the save phase runs AFTER the summary snapshot (like phase:save
+    # above): its run-index write counters live in the file only
+    assert set(file_counters) - set(tel["counters"]) <= \
+        {"store.index_rows", "store.index_writes"}
+    for name, v in tel["counters"].items():
+        assert file_counters[name] == v, name
 
     # the recorder is uninstalled after the run
     assert telemetry.current() is NULL
